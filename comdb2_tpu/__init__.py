@@ -1,0 +1,25 @@
+"""comdb2_tpu — a TPU-native distributed-systems test harness and
+linearizability checker.
+
+This package rebuilds the capabilities of the jepsen-io/comdb2 stack
+(the Jepsen harness + the Knossos linearizability checker, vendored in the
+reference under ``linearizable/jepsen/src/``) as a TPU-first framework:
+
+- ``comdb2_tpu.ops``      — operation & history core (knossos/op.clj,
+  knossos/history.clj semantics) plus packed tensor forms and EDN I/O.
+- ``comdb2_tpu.models``   — single-threaded datatype models and the
+  state-space memoization that lowers ``model.step`` to integer gathers
+  (knossos/model.clj, knossos/model/memo.clj).
+- ``comdb2_tpu.checker``  — the checker layer: the TPU batched-frontier
+  linearizability search (knossos/linear.clj as vmapped tensor ops),
+  a host reference implementation, and the non-linearizability checkers
+  (set / counter / queue / bank / dirty-reads / G2).
+- ``comdb2_tpu.parallel`` — device meshes, batching of independent
+  histories, sharded execution.
+- ``comdb2_tpu.harness``  — the test runtime: generators, clients,
+  workers, nemesis scheduling, the results store, and the CLI.
+- ``comdb2_tpu.control``  — the control plane: remote execution, network
+  partitions, clock and process faults.
+"""
+
+__version__ = "0.1.0"

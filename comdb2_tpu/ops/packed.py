@@ -1,0 +1,127 @@
+"""Packed struct-of-arrays history — the tensor form.
+
+This is the TPU-native analog of the reference's indexed op maps: every
+op becomes one row across parallel int arrays, with ``f`` and ``value``
+interned into id tables (the tensor equivalent of
+``knossos/model/memo.clj:40-59``'s ``canonical-history``). All checker
+device code consumes this form; the Op objects never leave the host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Hashable, List, Optional
+
+import numpy as np
+
+from .op import Op, TYPE_CODES
+from . import history as hist
+
+
+@dataclass
+class PackedHistory:
+    """A completed, indexed history as flat arrays.
+
+    Attributes
+    ----------
+    ops:        the (completed, indexed) Op list — kept for reporting.
+    process:    int32[n]  — interned process ids (see ``process_table``).
+    type:       int8[n]   — 0 invoke / 1 ok / 2 fail / 3 info.
+    f:          int32[n]  — interned f id.
+    value:      int32[n]  — interned value id (whole value; tuple values
+                             are interned as tuples).
+    trans:      int32[n]  — interned (f, value) transition id for
+                             invocations, -1 elsewhere (the tensor form of
+                             ``memo.clj:131-142``'s transition-index).
+    pair:       int32[n]  — index of the op's invocation/completion
+                             partner, -1 for infos.
+    fails:      bool[n]   — invocation will fail (skip in checkers).
+    time:       int64[n]  — wall-clock nanos, -1 if unknown.
+    *_table:    id → original object lookup lists.
+    """
+
+    ops: List[Op]
+    process: np.ndarray
+    type: np.ndarray
+    f: np.ndarray
+    value: np.ndarray
+    trans: np.ndarray
+    pair: np.ndarray
+    fails: np.ndarray
+    time: np.ndarray
+    process_table: List[Hashable]
+    f_table: List[Hashable]
+    value_table: List[Any]
+    transition_table: List[tuple]  # (f_id, value_id) per transition id
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    @property
+    def n_transitions(self) -> int:
+        return len(self.transition_table)
+
+
+class _Interner:
+    def __init__(self):
+        self.ids: Dict[Any, int] = {}
+        self.table: List[Any] = []
+
+    def __call__(self, x: Any) -> int:
+        try:
+            i = self.ids.get(x)
+        except TypeError:  # unhashable (shouldn't happen post-_plain)
+            x = repr(x)
+            i = self.ids.get(x)
+        if i is None:
+            i = len(self.table)
+            self.ids[x] = i
+            self.table.append(x)
+        return i
+
+
+def pack_history(history: List[Op], completed: bool = False) -> PackedHistory:
+    """Complete + index a history and pack it into arrays.
+
+    Pass ``completed=True`` if the history already went through
+    :func:`comdb2_tpu.ops.history.complete` and :func:`...history.index`.
+    """
+    if not completed:
+        history = hist.index(hist.complete(history))
+    n = len(history)
+    process = np.empty(n, np.int32)
+    type_ = np.empty(n, np.int8)
+    f_arr = np.empty(n, np.int32)
+    value = np.empty(n, np.int32)
+    trans = np.full(n, -1, np.int32)
+    pair = np.full(n, -1, np.int32)
+    fails = np.zeros(n, bool)
+    time = np.full(n, -1, np.int64)
+
+    iproc, if_, ival = _Interner(), _Interner(), _Interner()
+    itrans = _Interner()
+    inflight: Dict[Hashable, int] = {}
+
+    for i, op in enumerate(history):
+        process[i] = iproc(op.process)
+        type_[i] = TYPE_CODES[op.type]
+        f_arr[i] = if_(op.f)
+        value[i] = ival(op.value)
+        fails[i] = op.fails
+        if op.time is not None:
+            time[i] = op.time
+        if op.type == "invoke":
+            trans[i] = itrans((int(f_arr[i]), int(value[i])))
+            inflight[op.process] = i
+        elif op.type in ("ok", "fail"):
+            j = inflight.pop(op.process)
+            pair[i] = j
+            pair[j] = i
+
+    return PackedHistory(
+        ops=history,
+        process=process, type=type_, f=f_arr, value=value, trans=trans,
+        pair=pair, fails=fails, time=time,
+        process_table=iproc.table, f_table=if_.table, value_table=ival.table,
+        transition_table=itrans.table,
+    )

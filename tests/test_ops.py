@@ -1,0 +1,136 @@
+"""Phase 0 tests: op/history core and EDN io.
+
+Mirrors the observable behavior of knossos/history.clj (complete/index/
+pairs) and the filetest EDN interchange format."""
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.ops import (
+    invoke, ok, fail, info, complete, index, pairs, pair_index,
+    read_edn, read_edn_all, write_edn, kw, pack_history,
+)
+from comdb2_tpu.ops.history import parse_history, history_to_edn, op_from_map
+
+
+def test_complete_backfills_ok_value():
+    h = [invoke(0, "read", None), ok(0, "read", 2)]
+    h2 = complete(h)
+    assert h2[0].value == 2
+    assert not h2[0].fails
+
+
+def test_complete_marks_fails():
+    h = [invoke(0, "write", 3), fail(0, "write", 3)]
+    h2 = complete(h)
+    assert h2[0].fails and h2[1].fails
+    assert h2[0].value == 3
+
+
+def test_complete_fail_takes_known_value():
+    h = [invoke(0, "read", None), fail(0, "read", 7)]
+    h2 = complete(h)
+    assert h2[0].value == 7 and h2[0].fails
+
+
+def test_complete_interleaved_processes():
+    h = [invoke(0, "read", None),
+         invoke(1, "write", 5),
+         ok(1, "write", 5),
+         ok(0, "read", 5)]
+    h2 = complete(h)
+    assert h2[0].value == 5      # read invocation back-filled
+    assert h2[0].process == 0
+
+
+def test_complete_rejects_concurrent_same_process():
+    h = [invoke(0, "read", None), invoke(0, "write", 1)]
+    with pytest.raises(RuntimeError):
+        complete(h)
+
+
+def test_info_passes_through_and_stays_pending():
+    h = [invoke(0, "write", 1), info(0, "write", 1), info("nemesis", "start")]
+    h2 = complete(h)
+    assert [op.type for op in h2] == ["invoke", "info", "info"]
+    assert h2[0].value == 1
+
+
+def test_index_and_pairs():
+    h = index(complete([invoke(0, "read", None),
+                        invoke(1, "write", 5),
+                        ok(0, "read", None),
+                        info("nemesis", "start"),
+                        ok(1, "write", 5)]))
+    assert [op.index for op in h] == [0, 1, 2, 3, 4]
+    pi = pair_index(h)
+    assert pi[0] == 2 and pi[2] == 0
+    assert pi[1] == 4 and pi[4] == 1
+    assert pi[3] is None
+    ps = pairs(h)
+    assert [(a.index, b.index if b else None) for a, b in ps] == [
+        (0, 2), (3, None), (1, 4)]
+
+
+def test_edn_roundtrip():
+    s = '{:type :invoke, :f :cas, :value [0 3], :process 1, :time 1234}'
+    m = read_edn(s)
+    assert m[kw("type")] == kw("invoke")
+    assert m[kw("value")] == [0, 3]
+    out = write_edn(m)
+    assert read_edn(out) == m
+
+
+def test_edn_various_forms():
+    assert read_edn("nil") is None
+    assert read_edn("true") is True
+    assert read_edn("[1 2.5 \"hi\" :a nil]") == [1, 2.5, "hi", kw("a"), None]
+    assert read_edn("#{1 2}") == {1, 2}
+    assert read_edn("; comment\n42") == 42
+    assert read_edn("#inst \"2016\"") == "2016"  # tag dropped
+    assert read_edn_all("{:a 1}\n{:a 2}") == [{kw("a"): 1}, {kw("a"): 2}]
+
+
+def test_parse_history_ctest_format():
+    # format emitted by the reference's ctest/register.c -j flag
+    text = """[
+      {:type :invoke :f :write :value 3 :process 0 :time 10}
+      {:type :ok :f :write :value 3 :process 0 :time 20}
+      {:type :invoke :f :read :value nil :process 1 :time 30}
+      {:type :ok :f :read :value 3 :process 1 :time 40}
+    ]"""
+    h = parse_history(text)
+    assert len(h) == 4
+    assert h[0].f == "write" and h[0].value == 3
+    assert h[3].value == 3
+    # cas values come through as tuples
+    m = read_edn("{:type :invoke :f :cas :value [1 2] :process 0}")
+    assert op_from_map(m).value == (1, 2)
+
+
+def test_history_to_edn_roundtrip():
+    h = index(complete([invoke(0, "write", 3), ok(0, "write", 3)]))
+    text = history_to_edn(h)
+    h2 = parse_history(text)
+    assert [(o.process, o.type, o.f, o.value) for o in h2] == [
+        (0, "invoke", "write", 3), (0, "ok", "write", 3)]
+
+
+def test_pack_history():
+    h = [invoke(0, "write", 3), ok(0, "write", 3),
+         invoke(1, "read", None), ok(1, "read", 3),
+         invoke(0, "cas", (3, 4)), fail(0, "cas", (3, 4)),
+         info("nemesis", "start", None)]
+    p = pack_history(h)
+    assert len(p) == 7
+    assert list(p.type) == [0, 1, 0, 1, 0, 2, 3]
+    assert p.pair[0] == 1 and p.pair[1] == 0
+    assert p.pair[6] == -1
+    assert p.fails[4] and p.fails[5]
+    # read invocation's transition uses the back-filled value 3
+    read_t = p.trans[2]
+    fid, vid = p.transition_table[read_t]
+    assert p.f_table[fid] == "read" and p.value_table[vid] == 3
+    # distinct transitions: write 3, read 3, cas (3,4)
+    assert p.n_transitions == 3
+    assert p.process_table[p.process[6]] == "nemesis"
