@@ -19,10 +19,9 @@ from typing import Any, Iterator, List, Tuple
 
 
 class Keyword(str):
-    """An EDN keyword. Subclasses str so ``kw("read") == "read"`` is False
-    only for plain-string comparison by identity of type — we deliberately
-    make keywords compare equal to their names to keep host code simple:
-    ``op[":type"]``-style juggling is avoided; ``Keyword("a") == "a"``.
+    """An EDN keyword. Subclasses str, so a keyword compares equal to its
+    name: ``kw("read") == "read"`` is True. This is deliberate — host code
+    never needs ``op[":type"]``-style juggling.
     """
 
     __slots__ = ()

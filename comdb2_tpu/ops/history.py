@@ -122,14 +122,18 @@ def index(history: List[Op]) -> List[Op]:
 # --- EDN interchange -------------------------------------------------------
 
 def _plain(x: Any) -> Any:
-    """Normalize an EDN value: keywords → plain strings, lists → tuples,
-    so values are hashable and compare naturally."""
+    """Normalize an EDN value: keywords → plain strings, lists/tuples →
+    tuples, sets → frozensets, maps → sorted tuples of pairs, so values
+    are hashable and compare naturally."""
     if isinstance(x, Keyword):
         return str.__str__(x)
-    if isinstance(x, list):
+    if isinstance(x, (list, tuple)):
         return tuple(_plain(e) for e in x)
-    if isinstance(x, tuple):
-        return tuple(_plain(e) for e in x)
+    if isinstance(x, (set, frozenset)):
+        return frozenset(_plain(e) for e in x)
+    if isinstance(x, dict):
+        return tuple(sorted(((_plain(k), _plain(v)) for k, v in x.items()),
+                            key=repr))
     return x
 
 

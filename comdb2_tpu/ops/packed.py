@@ -68,11 +68,9 @@ class _Interner:
         self.table: List[Any] = []
 
     def __call__(self, x: Any) -> int:
-        try:
-            i = self.ids.get(x)
-        except TypeError:  # unhashable (shouldn't happen post-_plain)
-            x = repr(x)
-            i = self.ids.get(x)
+        # _plain guarantees hashability; an unhashable value here is a
+        # driver bug and silently interning its repr would skew verdicts
+        i = self.ids.get(x)
         if i is None:
             i = len(self.table)
             self.ids[x] = i

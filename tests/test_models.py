@@ -1,0 +1,123 @@
+"""Phase 1 tests: models and state-space memoization.
+
+Behavioral parity targets: knossos/model.clj:48-161, jepsen/model.clj:58-105,
+knossos/model/memo.clj:93-196.
+"""
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.models import (
+    register, cas_register, cas_register_comdb2, mutex, multi_register,
+    set_model, unordered_queue, fifo_queue, step,
+    memo, memoize_model, MemoOverflow,
+)
+from comdb2_tpu.ops import invoke, ok, pack_history
+
+
+def test_register():
+    m = register()
+    m = step(m, "write", 3)
+    assert step(m, "read", 3) == m
+    assert step(m, "read", 4) is None
+    assert step(m, "read", None) == m  # unknown read matches anything
+    assert step(step(m, "write", 5), "read", 5) is not None
+
+
+def test_cas_register():
+    m = cas_register(0)
+    assert step(m, "cas", (0, 2)).value == 2
+    assert step(m, "cas", (1, 2)) is None
+    assert step(m, "write", 9).value == 9
+    assert step(m, "read", 0) == m
+    assert step(m, "read", 1) is None
+    # inconsistency is absorbing
+    assert step(step(m, "read", 1), "write", 3) is None
+
+
+def test_cas_register_comdb2_tuple_values():
+    m = cas_register_comdb2(None)
+    m = step(m, "write", (7, 1))        # key 7, value 1
+    assert m.value == 1
+    assert step(m, "read", (7, 1)) == m
+    assert step(m, "cas", (7, (1, 2))).value == 2
+    assert step(m, "cas", (7, (3, 2))) is None
+
+
+def test_mutex():
+    m = mutex()
+    m2 = step(m, "acquire", None)
+    assert m2 is not None
+    assert step(m2, "acquire", None) is None
+    assert step(m2, "release", None) == m
+    assert step(m, "release", None) is None
+
+
+def test_multi_register():
+    m = multi_register({"x": 0, "y": 0})
+    m2 = step(m, "txn", (("write", "x", 1), ("read", "y", 0)))
+    assert m2 is not None
+    assert step(m2, "txn", (("read", "x", 1),)) is not None
+    assert step(m2, "txn", (("read", "x", 0),)) is None
+
+
+def test_set_model():
+    m = set_model()
+    m = step(m, "add", 1)
+    m = step(m, "add", 2)
+    assert step(m, "read", (1, 2)) == m
+    assert step(m, "read", (1,)) is None
+    assert step(m, "read", None) == m
+
+
+def test_queues():
+    uq = unordered_queue()
+    uq = step(uq, "enqueue", 1)
+    uq = step(uq, "enqueue", 2)
+    assert step(uq, "dequeue", 2) is not None   # any order ok
+    assert step(uq, "dequeue", 3) is None
+
+    fq = fifo_queue()
+    fq = step(fq, "enqueue", 1)
+    fq = step(fq, "enqueue", 2)
+    assert step(fq, "dequeue", 1) is not None
+    assert step(fq, "dequeue", 2) is None       # must be FIFO
+
+
+def test_memoize_register():
+    transitions = [("write", 0), ("write", 1), ("read", 0), ("read", 1)]
+    mm = memoize_model(register(), transitions)
+    # states: None, 0, 1
+    assert mm.n_states == 3
+    assert mm.n_transitions == 4
+    s0 = 0
+    s_after_w0 = mm.step_id(s0, 0)
+    assert s_after_w0 != -1
+    # read 0 in that state loops; read 1 is inconsistent
+    assert mm.step_id(s_after_w0, 2) == s_after_w0
+    assert mm.step_id(s_after_w0, 3) == -1
+    # write is total: no -1 anywhere in write columns
+    assert (mm.succ[:, 0] >= 0).all() and (mm.succ[:, 1] >= 0).all()
+
+
+def test_memo_from_history():
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "cas", (1, 2)), ok(1, "cas", (1, 2)),
+         invoke(0, "read", None), ok(0, "read", 2)]
+    p = pack_history(h)
+    mm = memo(cas_register(), p)
+    # succ has one column per distinct history transition
+    assert mm.succ.shape[1] == p.n_transitions
+    # replay sequentially through the table
+    s = 0
+    for i in range(len(p)):
+        if p.type[i] == 0:  # invoke
+            s = mm.step_id(s, int(p.trans[i]))
+            assert s != -1
+    assert mm.states[s].value == 2
+
+
+def test_memo_overflow():
+    transitions = [("add", i) for i in range(20)]
+    with pytest.raises(MemoOverflow):
+        memoize_model(set_model(), transitions, max_states=1000)

@@ -116,6 +116,18 @@ def test_history_to_edn_roundtrip():
         (0, "invoke", "write", 3), (0, "ok", "write", 3)]
 
 
+def test_plain_normalizes_sets_and_maps():
+    # EDN sets/maps must intern as hashable values, not repr strings
+    h = parse_history(
+        "[{:type :invoke :f :read :value nil :process 0}"
+        " {:type :ok :f :read :value #{1 2} :process 0}"
+        " {:type :invoke :f :txn :value {:x 1} :process 1}"
+        " {:type :ok :f :txn :value {:x 1} :process 1}]")
+    p = pack_history(h)
+    assert frozenset({1, 2}) in p.value_table
+    assert (("x", 1),) in p.value_table
+
+
 def test_pack_history():
     h = [invoke(0, "write", 3), ok(0, "write", 3),
          invoke(1, "read", None), ok(1, "read", 3),
