@@ -1,0 +1,16 @@
+"""Linearizability checking engines.
+
+- :mod:`comdb2_tpu.checker.brute` — tiny exhaustive WGL-style search used
+  as an independent oracle in tests.
+- :mod:`comdb2_tpu.checker.linear_host` — host (NumPy/Python) reference
+  implementation of just-in-time linearization over a memoized model
+  (the semantics of ``knossos/linear.clj``).
+- :mod:`comdb2_tpu.checker.linear_jax` — the batched, TPU-native frontier
+  search (the core deliverable).
+- :mod:`comdb2_tpu.checker.linear` — unified :func:`analysis` entry point
+  mirroring ``knossos.linear/analysis`` (``linear.clj:299``).
+"""
+
+from .linear import analysis, Analysis
+
+__all__ = ["analysis", "Analysis"]

@@ -1,0 +1,109 @@
+"""Batched history packing — many independent histories, one launch.
+
+The device analog of ``jepsen.independent``'s per-key partitioning
+(``independent.clj:252-300``): N short histories (e.g. one per register
+key) are checked as ONE vmapped/sharded device computation. This module
+owns the host-side glue: interning every history's transitions into a
+single shared table, memoizing the model once over that union, and
+padding per-history step streams to a common length.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Union
+
+import numpy as np
+
+from ..models.memo import MemoizedModel, memoize_model, transitions_of
+from ..models.model import Model
+from ..ops.op import INVOKE, Op
+from ..ops.packed import PackedHistory, pack_history
+from . import linear_jax as LJ
+
+
+def _next_pow2(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class PackedBatch:
+    """N histories compiled against one shared successor table."""
+
+    packeds: List[PackedHistory]
+    memo: MemoizedModel
+    kind: np.ndarray   # int32[N, n_pad]
+    proc: np.ndarray   # int32[N, n_pad]
+    tr: np.ndarray     # int32[N, n_pad] — ids into the shared table
+    P: int             # max process count (slot width)
+
+    def __len__(self) -> int:
+        return len(self.packeds)
+
+
+def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
+               model: Model,
+               max_states: int = 1 << 20,
+               n_pad: int = 0) -> PackedBatch:
+    """Pack histories for :func:`~.linear_jax.check_device_batch` /
+    :func:`~.linear_jax.check_sharded`.
+
+    Transition ids are re-interned into one union table so all histories
+    share a single memoized model; the BFS depth bound is the max
+    invocation count over the batch (exact per history — a history can't
+    linearize more ops than it invoked; see ``memoize_model``).
+    """
+    packeds = [h if isinstance(h, PackedHistory) else pack_history(list(h))
+               for h in histories]
+    union: List[tuple] = []
+    ids = {}
+    remaps = []
+    for p in packeds:
+        local = []
+        for t in transitions_of(p):
+            if t not in ids:
+                ids[t] = len(union)
+                union.append(t)
+            local.append(ids[t])
+        remaps.append(np.asarray(local, np.int32))
+    n_inv = max((int(((p.type == INVOKE) & ~p.fails).sum())
+                 for p in packeds), default=0)
+    mm = memoize_model(model, union, max_states=max_states, max_depth=n_inv)
+
+    n_pad = max(n_pad, _next_pow2(max((len(p) for p in packeds), default=1)))
+    P = max((len(p.process_table) for p in packeds), default=1)
+    kinds, procs, trs = [], [], []
+    for p, remap in zip(packeds, remaps):
+        s = LJ.make_stream(p, n_pad=n_pad)
+        kind = np.asarray(s.kind)
+        tr = np.asarray(s.tr).copy()
+        mask = kind == LJ.K_INVOKE
+        if remap.size:
+            tr[mask] = remap[tr[mask]]
+        kinds.append(kind)
+        procs.append(np.asarray(s.proc))
+        trs.append(tr)
+    return PackedBatch(packeds=packeds, memo=mm,
+                       kind=np.stack(kinds), proc=np.stack(procs),
+                       tr=np.stack(trs), P=P)
+
+
+def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
+                batch_axis: str = "batch"):
+    """Run the batched device search; returns (status[N], fail_at[N],
+    n_final[N]) NumPy arrays. With ``mesh``, the batch axis is sharded
+    across devices (data parallelism over ICI)."""
+    succ = LJ.pad_succ(batch.memo.succ,
+                       _next_pow2(batch.memo.succ.shape[0]),
+                       _next_pow2(batch.memo.succ.shape[1]))
+    P = _next_pow2(batch.P, 2)
+    if mesh is not None:
+        out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc, batch.tr,
+                               F=F, P=P, batch_axis=batch_axis)
+    else:
+        out = LJ.check_device_batch(succ, batch.kind, batch.proc, batch.tr,
+                                    F=F, P=P)
+    return tuple(np.asarray(x) for x in out)

@@ -1,0 +1,151 @@
+"""Unified linearizability analysis — the ``knossos.linear/analysis``
+equivalent (``linear.clj:299-355``).
+
+Pipeline (mirroring the reference's): ``complete`` → ``index`` → pack →
+``memo`` → frontier search → decoded verdict. Small histories run on the
+host engine (the analog of staying single-threaded below the reference's
+128-config pmap threshold, ``linear.clj:214-216``); larger ones run the
+device engine with escalating frontier capacity, where overflow at the
+largest capacity yields ``:unknown`` exactly like the reference's
+low-memory abort (``linear.clj:318-326``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..models.memo import MemoOverflow, MemoizedModel, memo as make_memo
+from ..models.model import Model
+from ..ops.op import Op
+from ..ops.packed import PackedHistory, pack_history
+from . import linear_host
+
+UNKNOWN = "unknown"
+
+
+@dataclass
+class Analysis:
+    """Checker verdict. ``valid`` is ``True``, ``False``, or
+    ``"unknown"`` (search gave up — same tri-state as the reference's
+    ``:valid?``)."""
+
+    valid: Union[bool, str]
+    op: Optional[Op] = None            # op at which the search died
+    op_index: Optional[int] = None
+    configs: List[dict] = field(default_factory=list)  # frontier sample
+    final_count: int = 0
+    info: dict = field(default_factory=dict)
+
+    @property
+    def valid_(self) -> Union[bool, str]:  # reference-style accessor
+        return self.valid
+
+    def to_map(self) -> dict:
+        m = {"valid?": self.valid}
+        if self.op is not None:
+            m["op"] = self.op
+            m["op-index"] = self.op_index
+            m["configs"] = self.configs
+        m.update(self.info)
+        return m
+
+
+def _next_pow2(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+def analysis(model: Model,
+             history: Union[Sequence[Op], PackedHistory],
+             backend: str = "auto",
+             capacities: Sequence[int] = (64, 1024, 8192, 65536),
+             host_threshold: int = 128,
+             max_states: int = 1 << 20,
+             max_host_configs: int = 1 << 22) -> Analysis:
+    """Check ``history`` against ``model`` for linearizability.
+
+    backend: "auto" | "host" | "device".
+    capacities: device frontier sizes tried in order; overflow escalates,
+    overflow at the last yields :unknown.
+    """
+    t0 = time.monotonic()
+    packed = (history if isinstance(history, PackedHistory)
+              else pack_history(list(history)))
+    n = len(packed)
+    P = len(packed.process_table)
+    if n == 0 or P == 0:
+        return Analysis(valid=True, info={"backend": "trivial"})
+
+    try:
+        mm = make_memo(model, packed, max_states=max_states)
+    except MemoOverflow as e:
+        return Analysis(valid=UNKNOWN, info={"cause": str(e)})
+
+    if backend == "host" or (backend == "auto" and n < host_threshold):
+        return _analyze_host(mm, packed, max_host_configs, t0)
+    return _analyze_device(mm, packed, capacities, t0)
+
+
+def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
+                  max_configs: int, t0: float) -> Analysis:
+    try:
+        r = linear_host.check(mm, packed, max_configs=max_configs)
+    except linear_host.FrontierOverflow as e:
+        return Analysis(valid=UNKNOWN, info={"cause": str(e),
+                                             "backend": "host"})
+    info = {"backend": "host", "max_frontier": r.max_frontier,
+            "time_s": time.monotonic() - t0}
+    if r.valid:
+        return Analysis(valid=True, final_count=r.final_count, info=info)
+    op = packed.ops[r.op_index]
+    cfgs = [linear_host.describe_config(mm, packed, c)
+            for c in r.configs[:10]]
+    return Analysis(valid=False, op=op, op_index=r.op_index,
+                    configs=cfgs, info=info)
+
+
+def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
+                    capacities: Sequence[int], t0: float) -> Analysis:
+    from . import linear_jax as LJ
+
+    P = len(packed.process_table)
+    succ = LJ.pad_succ(mm.succ, _next_pow2(mm.succ.shape[0]),
+                       _next_pow2(mm.succ.shape[1]))
+    stream = LJ.make_stream(packed, n_pad=_next_pow2(len(packed), 256))
+    info: dict = {"backend": "device", "n_states": mm.n_states,
+                  "n_transitions": mm.n_transitions}
+    for F in capacities:
+        status, fail_at, n_final = LJ.check_device(
+            succ, *stream, F=F, P=_next_pow2(P, 2))
+        status = int(status)
+        info["frontier_capacity"] = F
+        if status != LJ.UNKNOWN:
+            break
+    info["time_s"] = time.monotonic() - t0
+    if status == LJ.VALID:
+        return Analysis(valid=True, final_count=int(n_final), info=info)
+    if status == LJ.UNKNOWN:
+        return Analysis(valid=UNKNOWN, op_index=int(fail_at),
+                        info={**info, "cause": "frontier overflow"})
+    # invalid: decode counterexample context on host (the final-paths
+    # role, linear.clj:180-212); bounded so it can't explode
+    op_index = int(fail_at)
+    op = packed.ops[op_index]
+    cfgs: List[dict] = []
+    try:
+        r = linear_host.check(mm, packed, max_configs=1 << 16)
+        if not r.valid:
+            cfgs = [linear_host.describe_config(mm, packed, c)
+                    for c in r.configs[:10]]
+            op_index = r.op_index
+            op = packed.ops[op_index]
+    except linear_host.FrontierOverflow:
+        pass
+    return Analysis(valid=False, op=op, op_index=op_index, configs=cfgs,
+                    info=info)

@@ -1,0 +1,239 @@
+"""TPU-native linearizability search — batched frontier expansion.
+
+The device form of :mod:`comdb2_tpu.checker.linear_host` (which itself
+carries the semantics of the reference's ``knossos/linear.clj``). Design:
+
+- The config set becomes a *fixed-capacity frontier*: ``states:int32[F]``,
+  ``slots:int32[F,P]``, ``valid:bool[F]``. ``slots`` is the tensor form of
+  the reference's packed ``ArrayProcesses`` int arrays
+  (``knossos/linear/config.clj:157-295``).
+- The history becomes three device arrays (``kind/proc/tr``) consumed by
+  one ``lax.scan``; each step switches on op kind. No Python control flow
+  depends on data — the 50k-op scan is a single XLA computation.
+- An ``ok`` op runs the linearization *closure* as a bounded
+  ``lax.while_loop``: one iteration linearizes any single pending call in
+  every config at once — an ``[F,P]`` gather into the memoized successor
+  table (``succ``) — then dedups frontier ∪ candidates by sorting 64-bit
+  config fingerprints and compacting survivors to the front. This
+  replaces the reference's per-op DFS + hash-set dedup
+  (``linear.clj:66-129``, ``SetConfigSet``) with sort/segment primitives
+  XLA maps well onto TPU.
+- Frontier overflow ⇒ verdict ``:unknown`` — the semantics of the
+  reference's low-memory abort (``linear.clj:318-326``). The driver
+  (:mod:`.linear`) escalates capacity and retries, so small histories pay
+  small sorts (the analog of the reference's 128-config pmap threshold,
+  ``linear.clj:214-216``).
+
+Fingerprints are two independent 32-bit FNV-style hashes; rows are only
+merged when the full row matches, so a hash collision can at worst keep
+a duplicate (lossy dedup is already accepted by the reference —
+``knossos/weak_cache_set.clj:22-37``), never drop a reachable config.
+The closure loop is additionally capped at P iterations (closure depth
+is bounded by the number of pending calls), so termination never
+depends on the heuristic change detector.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+IDLE = -1
+LIN = -2
+
+# op kinds in the precompiled step stream
+K_SKIP = 0     # fail/info completions, failing invokes, padding
+K_INVOKE = 1
+K_OK = 2
+
+# result status codes
+VALID = 0
+INVALID = 1
+UNKNOWN = 2    # frontier overflow
+
+
+class StepStream(NamedTuple):
+    """Host-precompiled per-op step metadata (see :func:`make_stream`)."""
+    kind: jnp.ndarray   # int32[n]
+    proc: jnp.ndarray   # int32[n]
+    tr: jnp.ndarray     # int32[n]
+
+
+def make_stream(packed, n_pad: Optional[int] = None) -> StepStream:
+    """Compile a PackedHistory into the device step stream. ``n_pad``
+    pads with no-op steps so histories of similar length share one
+    compiled program."""
+    from ..ops.op import INVOKE, OK
+    n = len(packed)
+    n_pad = n_pad or n
+    kind = np.zeros(n_pad, np.int32)
+    proc = np.zeros(n_pad, np.int32)
+    tr = np.zeros(n_pad, np.int32)
+    for i in range(n):
+        t = int(packed.type[i])
+        if t == INVOKE and not packed.fails[i]:
+            kind[i] = K_INVOKE
+            proc[i] = packed.process[i]
+            tr[i] = packed.trans[i]
+        elif t == OK:
+            kind[i] = K_OK
+            proc[i] = packed.process[i]
+    return StepStream(jnp.asarray(kind), jnp.asarray(proc), jnp.asarray(tr))
+
+
+def pad_succ(succ: np.ndarray, s_pad: Optional[int] = None,
+             t_pad: Optional[int] = None) -> np.ndarray:
+    """Pad the successor table to bucketed shapes (recompile avoidance).
+    Padding states/transitions are all-inconsistent (-1)."""
+    S, T = succ.shape
+    s_pad, t_pad = s_pad or S, t_pad or T
+    out = np.full((s_pad, t_pad), -1, np.int32)
+    out[:S, :T] = succ
+    return out
+
+
+def _fingerprints(states, slots):
+    """Two independent FNV-1a-style 32-bit row hashes."""
+    def fold(seed, prime):
+        h = jnp.full(states.shape, seed, jnp.uint32)
+        h = (h ^ states.astype(jnp.uint32)) * jnp.uint32(prime)
+        for q in range(slots.shape[1]):
+            h = (h ^ slots[:, q].astype(jnp.uint32)) * jnp.uint32(prime)
+        return h
+    return fold(2166136261, 16777619), fold(0x9E3779B9, 0x85EBCA77)
+
+
+def _dedup_compact(states, slots, valid, F):
+    """Sort rows so distinct valid configs are first; drop duplicates.
+    Returns (states[F], slots[F,P], valid[F], n_unique, overflow)."""
+    fp1, fp2 = _fingerprints(states, slots)
+    order = jnp.lexsort((fp2, fp1, ~valid))
+    st, sl = states[order], slots[order]
+    va, f1, f2 = valid[order], fp1[order], fp2[order]
+    pad = jnp.zeros(1, bool)
+    same = jnp.concatenate([pad, (f1[1:] == f1[:-1]) & (f2[1:] == f2[:-1])
+                            & (st[1:] == st[:-1])
+                            & jnp.all(sl[1:] == sl[:-1], axis=1)
+                            & va[:-1]])
+    keep = va & ~same
+    n = jnp.sum(keep)
+    order2 = jnp.argsort(~keep, stable=True)[:F]
+    return st[order2], sl[order2], keep[order2], n, n > F
+
+
+def _expand(succ, states, slots, valid):
+    """One linearization step applied to every (config, pending call):
+    returns F*P candidate rows (the vmapped ``t-lin``)."""
+    F, P = slots.shape
+    calling = slots >= 0
+    s2 = succ[states[:, None], jnp.maximum(slots, 0)]          # [F,P]
+    cand_valid = (valid[:, None] & calling & (s2 >= 0)).reshape(F * P)
+    cand_slots = jnp.broadcast_to(slots[:, None, :], (F, P, P))
+    cand_slots = cand_slots.at[:, jnp.arange(P), jnp.arange(P)].set(LIN)
+    return s2.reshape(F * P), cand_slots.reshape(F * P, P), cand_valid
+
+
+def _closure(succ, states, slots, valid, n_valid, F, P):
+    """Fixed point of single-call linearization with dedup."""
+    def cond(c):
+        _, _, _, _, changed, overflow, it = c
+        return changed & ~overflow & (it <= P)
+
+    def body(c):
+        st, sl, va, n, _, _, it = c
+        c_st, c_sl, c_va = _expand(succ, st, sl, va)
+        all_st = jnp.concatenate([st, c_st])
+        all_sl = jnp.concatenate([sl, c_sl])
+        all_va = jnp.concatenate([va, c_va])
+        st2, sl2, va2, n2, ovf = _dedup_compact(all_st, all_sl, all_va, F)
+        return st2, sl2, va2, n2, n2 > n, ovf, it + 1
+
+    init = body((states, slots, valid, n_valid,
+                 jnp.bool_(True), jnp.bool_(False), jnp.int32(0)))
+    st, sl, va, n, _, ovf, _ = lax.while_loop(cond, body, init)
+    return st, sl, va, n, ovf
+
+
+def _make_step(succ, F, P):
+    def step(carry, op):
+        states, slots, valid, n, status, fail_at = carry
+        kind, proc, tr, idx = op
+
+        def do_invoke(_):
+            return (states, slots.at[:, proc].set(tr), valid, n,
+                    status, fail_at)
+
+        def do_ok(_):
+            st, sl, va, _, ovf = _closure(succ, states, slots, valid, n, F, P)
+            returned = va & (sl[:, proc] == LIN)
+            sl2 = sl.at[:, proc].set(IDLE)
+            n2 = jnp.sum(returned)
+            st_new = jnp.where(ovf, UNKNOWN,
+                               jnp.where(n2 == 0, INVALID, VALID))
+            return (st, sl2, returned, n2, st_new.astype(jnp.int32),
+                    jnp.where(st_new == VALID, fail_at, idx))
+
+        def dispatch(_):
+            return lax.switch(kind, [lambda _: carry, do_invoke, do_ok], None)
+
+        carry2 = lax.cond(status == VALID, dispatch, lambda _: carry, None)
+        return carry2, None
+
+    return step
+
+
+def _check_impl(succ, kind, proc, tr, F: int, P: int):
+    n_ops = kind.shape[0]
+    states = jnp.zeros(F, jnp.int32)
+    slots = jnp.full((F, P), IDLE, jnp.int32)
+    valid = jnp.zeros(F, bool).at[0].set(True)
+    carry = (states, slots, valid, jnp.int32(1), jnp.int32(VALID),
+             jnp.int32(-1))
+    ops = (kind, proc, tr, jnp.arange(n_ops, dtype=jnp.int32))
+    step = _make_step(succ, F, P)
+    (states, slots, valid, n, status, fail_at), _ = lax.scan(
+        step, carry, ops)
+    return status, fail_at, n
+
+
+@functools.partial(jax.jit, static_argnames=("F", "P"))
+def check_device(succ, kind, proc, tr, *, F: int, P: int):
+    """Run the full search for one history on device.
+
+    Returns ``(status, fail_index, n_final)`` — status is VALID/INVALID/
+    UNKNOWN; fail_index is the history index of the op at which the
+    frontier died (or overflowed)."""
+    return _check_impl(succ, kind, proc, tr, F, P)
+
+
+# --- batched (independent histories) ---------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("F", "P"))
+def check_device_batch(succ, kind, proc, tr, *, F: int, P: int):
+    """vmap over a batch of histories sharing one successor table — the
+    TPU analog of ``independent/checker``'s per-key partitioning
+    (``independent.clj:252-300``): thousands of per-key histories check
+    in one launch."""
+    fn = functools.partial(_check_impl, succ, F=F, P=P)
+    return jax.vmap(fn)(kind, proc, tr)
+
+
+def check_sharded(mesh, succ, kind, proc, tr, *, F: int, P: int,
+                  batch_axis: str = "batch"):
+    """Shard a batch of independent histories across a device mesh: the
+    batch axis rides data parallelism over ICI; each device runs whole
+    (sub)histories — no intra-search communication (SURVEY §2.5 item 8).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+    batch_sh = NamedSharding(mesh, Pspec(batch_axis))
+    repl = NamedSharding(mesh, Pspec())
+    kind = jax.device_put(kind, batch_sh)
+    proc = jax.device_put(proc, batch_sh)
+    tr = jax.device_put(tr, batch_sh)
+    succ = jax.device_put(succ, repl)
+    return check_device_batch(succ, kind, proc, tr, F=F, P=P)

@@ -64,19 +64,35 @@ def transitions_of(packed: PackedHistory) -> List[Tuple[Any, Any]]:
 
 def memoize_model(model: Model,
                   transitions: List[Tuple[Any, Any]],
-                  max_states: int = 1 << 20) -> MemoizedModel:
+                  max_states: int = 1 << 20,
+                  max_depth: Optional[int] = None) -> MemoizedModel:
     """Fixed-point closure of ``model`` under ``transitions``.
 
     BFS from the initial model; every reachable state gets an id; the
     successor table is materialized densely (``memo.clj:156-170`` builds
     the same graph as linked wrapper objects).
+
+    ``max_depth`` bounds the BFS depth. With ``max_depth`` = the number
+    of invocations in the history this is *exact*, not an approximation:
+    a checking run linearizes each invocation at most once, so states
+    whose shortest path from the initial state exceeds the invocation
+    count can never be stepped into. (States *at* the depth bound get
+    all-inconsistent successor rows; reaching one consumes every
+    invocation, so such a config has no pending calls left to step.)
+    This keeps unbounded-growth models — queues, sets — finite where the
+    reference's unbounded closure (``memo.clj:93-97``) would diverge.
     """
     ids = {model: 0}
     states: List[Model] = [model]
     rows: List[List[int]] = []
     frontier = [model]
     T = len(transitions)
+    depth = 0
     while frontier:
+        if max_depth is not None and depth >= max_depth:
+            # terminal depth: never stepped (see docstring); -1 rows
+            rows.extend([[-1] * T] * len(frontier))
+            break
         next_frontier = []
         for m in frontier:
             row = []
@@ -97,6 +113,7 @@ def memoize_model(model: Model,
                 row.append(sid)
             rows.append(row)
         frontier = next_frontier
+        depth += 1
     succ = np.asarray(rows, np.int32).reshape(len(states), T)
     return MemoizedModel(states=states, transitions=transitions, succ=succ)
 
@@ -104,5 +121,10 @@ def memoize_model(model: Model,
 def memo(model: Model, packed: PackedHistory,
          max_states: int = 1 << 20) -> MemoizedModel:
     """Memoize ``model`` over the distinct transitions of ``packed``
-    (the reference's entry point, ``memo.clj:182-196``)."""
-    return memoize_model(model, transitions_of(packed), max_states)
+    (the reference's entry point, ``memo.clj:182-196``), with the BFS
+    depth bounded by the history's invocation count."""
+    from ..ops.op import INVOKE
+
+    n_invokes = int(((packed.type == INVOKE) & ~packed.fails).sum())
+    return memoize_model(model, transitions_of(packed), max_states,
+                         max_depth=n_invokes)

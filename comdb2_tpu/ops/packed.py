@@ -109,7 +109,11 @@ def pack_history(history: List[Op], completed: bool = False) -> PackedHistory:
         if op.time is not None:
             time[i] = op.time
         if op.type == "invoke":
-            trans[i] = itrans((int(f_arr[i]), int(value[i])))
+            # failing invokes never linearize (checkers skip them,
+            # linear.clj:226), so their transitions must not enter the
+            # table — they'd inflate the memoized state space for nothing
+            if not op.fails:
+                trans[i] = itrans((int(f_arr[i]), int(value[i])))
             inflight[op.process] = i
         elif op.type in ("ok", "fail"):
             j = inflight.pop(op.process)

@@ -1,0 +1,138 @@
+"""Host JIT-linearization engine vs. hand-built cases and the brute
+oracle."""
+
+import random
+
+import pytest
+
+from comdb2_tpu.checker import linear_host
+from comdb2_tpu.checker.brute import brute_valid
+from comdb2_tpu.models.memo import memo as make_memo
+from comdb2_tpu.models import model as M
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.packed import pack_history
+
+import histgen
+
+
+def run(model, history):
+    packed = pack_history(history)
+    mm = make_memo(model, packed)
+    return linear_host.check(mm, packed)
+
+
+def test_sequential_register_valid():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(0, "read", None), O.ok(0, "read", 1)]
+    assert run(M.register(), h).valid
+
+
+def test_stale_read_invalid():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(0, "read", None), O.ok(0, "read", 2)]
+    r = run(M.register(), h)
+    assert not r.valid
+    assert r.op_index == 3
+
+
+def test_concurrent_read_may_see_either():
+    # read overlaps the write: both 1 (new) and None (old) are fine
+    for seen in (1, None):
+        h = [O.invoke(0, "write", 1),
+             O.invoke(1, "read", None),
+             O.ok(1, "read", seen),
+             O.ok(0, "write", 1)]
+        assert run(M.register(), h).valid
+    # a non-overlapping later read must see the write (note: a *nil*-valued
+    # completed read means "result unknown" and matches any state, per the
+    # reference Register model, knossos/model.clj:48-65)
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(1, "read", None), O.ok(1, "read", 2)]
+    assert not run(M.register(), h).valid
+
+
+def test_cas_semantics():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(0, "cas", (1, 2)), O.ok(0, "cas", (1, 2)),
+         O.invoke(0, "read", None), O.ok(0, "read", 2)]
+    assert run(M.cas_register(), h).valid
+    h[3] = O.ok(0, "cas", (3, 2))
+    h[2] = O.invoke(0, "cas", (3, 2))
+    assert not run(M.cas_register(), h).valid
+
+
+def test_failed_op_never_happened():
+    # failed write must NOT be visible
+    h = [O.invoke(0, "write", 1), O.fail(0, "write", 1),
+         O.invoke(0, "read", None), O.ok(0, "read", 1)]
+    assert not run(M.register(), h).valid
+    h = [O.invoke(0, "write", 1), O.fail(0, "write", 1),
+         O.invoke(0, "read", None), O.ok(0, "read", None)]
+    assert run(M.register(), h).valid
+
+
+def test_info_op_may_or_may_not_happen():
+    # crashed write: both outcomes legal (history.clj:127-145 semantics)
+    for seen in (1, None):
+        h = [O.invoke(0, "write", 1), O.info(0, "write", 1),
+             O.invoke(1, "read", None), O.ok(1, "read", seen)]
+        assert run(M.register(), h).valid, f"seen={seen}"
+
+
+def test_info_op_pins_later_state():
+    # committed write of 9; crashed write of 1; a read seeing 1 pins the
+    # crashed write as linearized, so a later read must not see 9 again
+    h = [O.invoke(1, "write", 9), O.ok(1, "write", 9),
+         O.invoke(0, "write", 1), O.info(0, "write", 1),
+         O.invoke(1, "read", None), O.ok(1, "read", 1),
+         O.invoke(1, "read", None), O.ok(1, "read", 9)]
+    assert not run(M.register(), h).valid
+
+
+def test_mutex():
+    h = [O.invoke(0, "acquire", None), O.ok(0, "acquire", None),
+         O.invoke(1, "acquire", None),
+         O.invoke(0, "release", None), O.ok(0, "release", None),
+         O.ok(1, "acquire", None)]
+    assert run(M.mutex(), h).valid
+    # two non-overlapping acquires with no release: invalid
+    h = [O.invoke(0, "acquire", None), O.ok(0, "acquire", None),
+         O.invoke(1, "acquire", None), O.ok(1, "acquire", None)]
+    assert not run(M.mutex(), h).valid
+
+
+def test_fifo_queue():
+    h = [O.invoke(0, "enqueue", 1), O.ok(0, "enqueue", 1),
+         O.invoke(0, "enqueue", 2), O.ok(0, "enqueue", 2),
+         O.invoke(1, "dequeue", None), O.ok(1, "dequeue", 1)]
+    assert run(M.fifo_queue(), h).valid
+    h[-1] = O.ok(1, "dequeue", 2)
+    assert not run(M.fifo_queue(), h).valid
+
+
+def test_empty_history_valid():
+    assert run(M.register(), []).valid
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_random_valid_histories(seed):
+    rng = random.Random(seed)
+    h = histgen.register_history(rng, n_procs=rng.randint(2, 4),
+                                 n_events=rng.randint(4, 14))
+    model = M.cas_register()
+    got = run(model, h)
+    want = brute_valid(model, h)
+    assert want, "generator must produce linearizable histories"
+    assert got.valid == want
+
+
+@pytest.mark.parametrize("seed", range(120))
+def test_random_mutated_histories_match_oracle(seed):
+    rng = random.Random(10_000 + seed)
+    h = histgen.register_history(rng, n_procs=rng.randint(2, 4),
+                                 n_events=rng.randint(4, 12))
+    h = histgen.mutate(rng, h)
+    model = M.cas_register()
+    got = run(model, h)
+    want = brute_valid(model, h)
+    assert got.valid == want

@@ -1,0 +1,131 @@
+"""Device (JAX) frontier search vs. the host reference engine."""
+
+import random
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.checker import analysis
+from comdb2_tpu.checker import linear_host, linear_jax as LJ
+from comdb2_tpu.checker.linear import _next_pow2
+from comdb2_tpu.models.memo import memo as make_memo
+from comdb2_tpu.models import model as M
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.packed import pack_history
+
+import histgen
+
+
+def device_check(model, history, F=64):
+    packed = pack_history(history)
+    mm = make_memo(model, packed)
+    P = max(1, len(packed.process_table))
+    stream = LJ.make_stream(packed)
+    status, fail_at, n = LJ.check_device(
+        LJ.pad_succ(mm.succ), *stream, F=F, P=P)
+    return int(status), int(fail_at), int(n)
+
+
+def test_device_simple_valid():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(0, "read", None), O.ok(0, "read", 1)]
+    status, _, n = device_check(M.register(), h)
+    assert status == LJ.VALID and n >= 1
+
+
+def test_device_simple_invalid():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(0, "read", None), O.ok(0, "read", 2)]
+    status, fail_at, _ = device_check(M.register(), h)
+    assert status == LJ.INVALID
+    assert fail_at == 3
+
+
+def test_device_overflow_is_unknown():
+    # many concurrent crashed writes of distinct values -> frontier blowup
+    h = []
+    for i in range(12):
+        h.append(O.invoke(i, "write", i))
+        h.append(O.info(i, "write", i))
+    h += [O.invoke(100, "read", None), O.ok(100, "read", 5)]
+    status, _, _ = device_check(M.register(), h, F=4)
+    assert status == LJ.UNKNOWN
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_device_matches_host_random(seed):
+    rng = random.Random(77_000 + seed)
+    h = histgen.register_history(rng, n_procs=rng.randint(2, 4),
+                                 n_events=rng.randint(4, 16),
+                                 p_info=0.1)
+    if rng.random() < 0.6:
+        h = histgen.mutate(rng, h)
+    model = M.cas_register()
+    packed = pack_history(h)
+    mm = make_memo(model, packed)
+    hr = linear_host.check(mm, packed)
+    status, fail_at, _ = device_check(model, h, F=256)
+    assert status in (LJ.VALID, LJ.INVALID)
+    assert (status == LJ.VALID) == hr.valid, f"host={hr.valid}"
+    if status == LJ.INVALID:
+        assert fail_at == hr.op_index
+
+
+def test_analysis_device_backend():
+    rng = random.Random(5)
+    h = histgen.register_history(rng, n_procs=3, n_events=40)
+    a = analysis(M.cas_register(), h, backend="device")
+    assert a.valid is True
+    h2 = histgen.mutate(random.Random(6), h)
+    from comdb2_tpu.checker.brute import brute_valid
+    a2 = analysis(M.cas_register(), h2, backend="device")
+    assert a2.valid == brute_valid(M.cas_register(), h2)
+    if a2.valid is False:
+        assert a2.op is not None
+
+
+def test_analysis_auto_small_uses_host():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1)]
+    a = analysis(M.register(), h)
+    assert a.valid is True
+    assert a.info["backend"] == "host"
+
+
+# --- batched ----------------------------------------------------------------
+
+def test_device_batch():
+    from comdb2_tpu.checker.batch import pack_batch, check_batch
+
+    model = M.cas_register()
+    histories, want = [], []
+    for seed in range(16):
+        rng = random.Random(31_000 + seed)
+        h = histgen.register_history(rng, n_procs=3,
+                                     n_events=rng.randint(6, 14))
+        if seed % 2:
+            h = histgen.mutate(rng, h)
+        histories.append(h)
+        packed = pack_history(h)
+        mm = make_memo(model, packed)
+        want.append(linear_host.check(mm, packed).valid)
+    batch = pack_batch(histories, model)
+    status, fail_at, n = check_batch(batch, F=128)
+    got = [s == LJ.VALID for s in status]
+    assert got == want
+
+
+def test_device_batch_sharded_mesh():
+    import jax
+    from jax.sharding import Mesh
+    from comdb2_tpu.checker.batch import pack_batch, check_batch
+
+    model = M.cas_register()
+    histories = []
+    for seed in range(8):
+        rng = random.Random(41_000 + seed)
+        histories.append(histgen.register_history(rng, n_procs=3,
+                                                  n_events=10))
+    batch = pack_batch(histories, model)
+    mesh = Mesh(np.array(jax.devices()), ("batch",))
+    status, fail_at, n = check_batch(batch, F=64, mesh=mesh)
+    assert all(s == LJ.VALID for s in status)

@@ -143,6 +143,8 @@ def test_pack_history():
     read_t = p.trans[2]
     fid, vid = p.transition_table[read_t]
     assert p.f_table[fid] == "read" and p.value_table[vid] == 3
-    # distinct transitions: write 3, read 3, cas (3,4)
-    assert p.n_transitions == 3
+    # distinct transitions: write 3, read 3 — the failing cas never
+    # linearizes, so its transition is not interned (trans stays -1)
+    assert p.n_transitions == 2
+    assert p.trans[4] == -1
     assert p.process_table[p.process[6]] == "nemesis"
