@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Headline benchmark: linearizability-check throughput on one chip.
+
+Checks a 50k-op, 5-process cas-register history (the north-star config
+from BASELINE.md: knossos-CPU times out at 1 h on this; target < 60 s)
+with the device frontier search, and reports checked ops/second.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is the speedup over the reference envelope's implied
+throughput at timeout (50,000 ops / 3600 s).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+N_OPS = 50_000
+N_PROCS = 5          # C register workload: 5 threads (ctest/register.c:28)
+BASELINE_OPS_S = N_OPS / 3600.0
+
+
+def main() -> None:
+    import jax
+    from comdb2_tpu.checker import linear_jax as LJ
+    from comdb2_tpu.models.memo import memo as make_memo
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.packed import pack_history
+    from comdb2_tpu.ops.synth import register_history
+
+    rng = random.Random(42)
+    history = register_history(rng, n_procs=N_PROCS, n_events=N_OPS,
+                               values=5, p_info=0.0)
+    packed = pack_history(history)
+    mm = make_memo(cas_register(), packed)
+    succ = LJ.pad_succ(mm.succ, 64, 64)
+    stream = LJ.make_stream(packed)
+    F, P = 128, 8
+
+    def run():
+        status, fail_at, n = LJ.check_device(succ, *stream, F=F, P=P)
+        jax.block_until_ready(status)
+        return int(status)
+
+    status = run()                        # compile + sanity
+    assert status == LJ.VALID, f"bench history misjudged: status={status}"
+    t0 = time.perf_counter()
+    run()
+    dt = time.perf_counter() - t0
+
+    ops_s = len(packed) / dt
+    print(json.dumps({
+        "metric": "linear_check_ops_per_s_50k",
+        "value": round(ops_s, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_s / BASELINE_OPS_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,14 +19,8 @@ from ..models.memo import MemoizedModel, memoize_model, transitions_of
 from ..models.model import Model
 from ..ops.op import INVOKE, Op
 from ..ops.packed import PackedHistory, pack_history
+from ..utils import next_pow2 as _next_pow2
 from . import linear_jax as LJ
-
-
-def _next_pow2(n: int, lo: int = 1) -> int:
-    p = lo
-    while p < n:
-        p *= 2
-    return p
 
 
 @dataclass

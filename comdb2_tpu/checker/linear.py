@@ -14,14 +14,13 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Sequence, Union
-
-import numpy as np
+from typing import List, Optional, Sequence, Union
 
 from ..models.memo import MemoOverflow, MemoizedModel, memo as make_memo
 from ..models.model import Model
 from ..ops.op import Op
 from ..ops.packed import PackedHistory, pack_history
+from ..utils import next_pow2 as _next_pow2
 from . import linear_host
 
 UNKNOWN = "unknown"
@@ -40,10 +39,6 @@ class Analysis:
     final_count: int = 0
     info: dict = field(default_factory=dict)
 
-    @property
-    def valid_(self) -> Union[bool, str]:  # reference-style accessor
-        return self.valid
-
     def to_map(self) -> dict:
         m = {"valid?": self.valid}
         if self.op is not None:
@@ -52,13 +47,6 @@ class Analysis:
             m["configs"] = self.configs
         m.update(self.info)
         return m
-
-
-def _next_pow2(n: int, lo: int = 1) -> int:
-    p = lo
-    while p < n:
-        p *= 2
-    return p
 
 
 def analysis(model: Model,

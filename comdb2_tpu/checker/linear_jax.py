@@ -13,9 +13,9 @@ carries the semantics of the reference's ``knossos/linear.clj``). Design:
 - An ``ok`` op runs the linearization *closure* as a bounded
   ``lax.while_loop``: one iteration linearizes any single pending call in
   every config at once — an ``[F,P]`` gather into the memoized successor
-  table (``succ``) — then dedups frontier ∪ candidates by sorting 64-bit
-  config fingerprints and compacting survivors to the front. This
-  replaces the reference's per-op DFS + hash-set dedup
+  table (``succ``) — then dedups frontier ∪ candidates by sorting rows
+  into an exact lexicographic order and compacting survivors to the
+  front. This replaces the reference's per-op DFS + hash-set dedup
   (``linear.clj:66-129``, ``SetConfigSet``) with sort/segment primitives
   XLA maps well onto TPU.
 - Frontier overflow ⇒ verdict ``:unknown`` — the semantics of the
@@ -24,13 +24,13 @@ carries the semantics of the reference's ``knossos/linear.clj``). Design:
   small sorts (the analog of the reference's 128-config pmap threshold,
   ``linear.clj:214-216``).
 
-Fingerprints are two independent 32-bit FNV-style hashes; rows are only
-merged when the full row matches, so a hash collision can at worst keep
-a duplicate (lossy dedup is already accepted by the reference —
-``knossos/weak_cache_set.clj:22-37``), never drop a reachable config.
-The closure loop is additionally capped at P iterations (closure depth
-is bounded by the number of pending calls), so termination never
-depends on the heuristic change detector.
+Dedup is exact: rows sort by their full contents, so every duplicate is
+adjacent to its twin and merged (hash-fingerprint ordering is *not*
+sound here — colliding non-identical rows can interleave between equal
+rows and break adjacency, ballooning the frontier into spurious
+overflow). The closure loop is additionally capped at P iterations
+(closure depth is bounded by the number of pending calls), so
+termination never depends on the heuristic change detector.
 """
 
 from __future__ import annotations
@@ -97,27 +97,18 @@ def pad_succ(succ: np.ndarray, s_pad: Optional[int] = None,
     return out
 
 
-def _fingerprints(states, slots):
-    """Two independent FNV-1a-style 32-bit row hashes."""
-    def fold(seed, prime):
-        h = jnp.full(states.shape, seed, jnp.uint32)
-        h = (h ^ states.astype(jnp.uint32)) * jnp.uint32(prime)
-        for q in range(slots.shape[1]):
-            h = (h ^ slots[:, q].astype(jnp.uint32)) * jnp.uint32(prime)
-        return h
-    return fold(2166136261, 16777619), fold(0x9E3779B9, 0x85EBCA77)
-
-
 def _dedup_compact(states, slots, valid, F):
-    """Sort rows so distinct valid configs are first; drop duplicates.
+    """Sort rows into an exact lexicographic order (valid first), so
+    identical configs are guaranteed adjacent; drop duplicates.
     Returns (states[F], slots[F,P], valid[F], n_unique, overflow)."""
-    fp1, fp2 = _fingerprints(states, slots)
-    order = jnp.lexsort((fp2, fp1, ~valid))
-    st, sl = states[order], slots[order]
-    va, f1, f2 = valid[order], fp1[order], fp2[order]
+    P = slots.shape[1]
+    # lexsort: last key is primary — valid rows first, then by full row
+    keys = tuple(slots[:, q] for q in range(P - 1, -1, -1)) \
+        + (states, ~valid)
+    order = jnp.lexsort(keys)
+    st, sl, va = states[order], slots[order], valid[order]
     pad = jnp.zeros(1, bool)
-    same = jnp.concatenate([pad, (f1[1:] == f1[:-1]) & (f2[1:] == f2[:-1])
-                            & (st[1:] == st[:-1])
+    same = jnp.concatenate([pad, (st[1:] == st[:-1])
                             & jnp.all(sl[1:] == sl[:-1], axis=1)
                             & va[:-1]])
     keep = va & ~same

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from .shapes import next_pow2
+
+__all__ = ["next_pow2"]

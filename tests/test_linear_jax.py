@@ -129,3 +129,21 @@ def test_device_batch_sharded_mesh():
     mesh = Mesh(np.array(jax.devices()), ("batch",))
     status, fail_at, n = check_batch(batch, F=64, mesh=mesh)
     assert all(s == LJ.VALID for s in status)
+
+
+def test_dedup_survives_sentinel_collisions():
+    """Regression: hash-fingerprint dedup collided on rows swapping 0 and
+    LIN(-2) across slots, interleaving equal rows and ballooning the
+    frontier into spurious overflow. Exact-sort dedup must agree with the
+    host engine at the host's true peak frontier size."""
+    rng = random.Random(7)
+    h = histgen.register_history(rng, n_procs=4, n_events=64, p_info=0.0)
+    packed = pack_history(h)
+    mm = make_memo(M.cas_register(), packed)
+    r = linear_host.check(mm, packed)
+    assert r.valid is True
+    F = _next_pow2(r.max_frontier)  # tightest power-of-two capacity
+    stream = LJ.make_stream(packed)
+    status, fail_at, n = LJ.check_device(LJ.pad_succ(mm.succ), *stream,
+                                         F=F, P=4)
+    assert int(status) == LJ.VALID
