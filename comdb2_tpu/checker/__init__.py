@@ -12,5 +12,12 @@
 """
 
 from .linear import analysis, Analysis
+from .checkers import (Checker, check_safe, compose, merge_valid,
+                       linearizable, Linearizable, unbridled_optimism,
+                       queue, set_checker, total_queue, counter)
+from . import independent, workloads
 
-__all__ = ["analysis", "Analysis"]
+__all__ = ["analysis", "Analysis", "Checker", "check_safe", "compose",
+           "merge_valid", "linearizable", "Linearizable",
+           "unbridled_optimism", "queue", "set_checker", "total_queue",
+           "counter", "independent", "workloads"]

@@ -1,0 +1,146 @@
+"""Independent-key lifting — the batch axis of the framework.
+
+Mirrors ``jepsen/independent.clj``: a test of one register lifts to a
+map of keys to registers by wrapping op values in ``(k, v)`` tuples,
+partitioning the history per key, and checking each subhistory with a
+base checker (``independent.clj:252-300``).
+
+TPU-native twist: when the base checker is :class:`~.checkers.Linearizable`,
+all per-key subhistories are packed against ONE shared memoized model and
+checked as a single vmapped (optionally mesh-sharded) device launch
+(:mod:`comdb2_tpu.checker.batch`) instead of one JVM ``check`` per key —
+this is BASELINE config 5, the per-key data parallelism of SURVEY §2.5
+item 5 moved onto the device batch axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from ..ops.op import Op
+from .checkers import Checker, Linearizable, check_safe, merge_valid
+
+
+class KVTuple(tuple):
+    """A key/value pair distinguishable from ordinary tuple values —
+    the analog of the reference's ``clojure.lang.MapEntry``
+    (``independent.clj:20-28``)."""
+
+    __slots__ = ()
+
+    def __new__(cls, k, v):
+        return tuple.__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+
+def tuple_(k, v) -> KVTuple:
+    return KVTuple(k, v)
+
+
+def is_tuple(x: Any) -> bool:
+    return isinstance(x, KVTuple)
+
+
+def wrap_keyed_history(history: Iterable[Op]) -> List[Op]:
+    """Re-tag 2-element tuple values as :class:`KVTuple`. EDN histories
+    (e.g. from the C register driver) carry ``[k v]`` vectors with no
+    type marker; call this when a history is known to be keyed."""
+    out = []
+    for op in history:
+        v = op.value
+        if (isinstance(v, (tuple, list)) and len(v) == 2
+                and not isinstance(v, KVTuple)):
+            op = op.with_(value=KVTuple(v[0], v[1]))
+        out.append(op)
+    return out
+
+
+def history_keys(history: Iterable[Op]) -> List[Any]:
+    """Distinct keys in first-appearance order
+    (``independent.clj:227-238``)."""
+    seen: Dict[Any, None] = {}
+    for op in history:
+        if is_tuple(op.value):
+            seen.setdefault(op.value.key, None)
+    return list(seen)
+
+
+def subhistory(k, history: Iterable[Op]) -> List[Op]:
+    """All ops without a differing key, tuples unwrapped — un-keyed ops
+    (nemesis infos, logging) appear in every subhistory
+    (``independent.clj:240-250``)."""
+    out = []
+    for op in history:
+        v = op.value
+        if not is_tuple(v):
+            out.append(op)
+        elif v.key == k:
+            out.append(op.with_(value=v.value))
+    return out
+
+
+class IndependentChecker(Checker):
+    """Lift a base checker over keyed histories: valid iff valid for
+    every key's subhistory; per-key results under ``"results"``, invalid
+    keys under ``"failures"`` (``independent.clj:252-300``)."""
+
+    def __init__(self, base: Checker, batch_frontier: int = 256,
+                 mesh=None):
+        self.base = base
+        self.batch_frontier = batch_frontier
+        self.mesh = mesh
+
+    def check(self, test, model, history, opts=None):
+        ks = history_keys(history)
+        subs = {k: subhistory(k, history) for k in ks}
+        if isinstance(self.base, Linearizable) and len(ks) > 1:
+            results = self._check_linearizable_batch(model, subs)
+        else:
+            results = {k: check_safe(self.base, test, model, subs[k], opts)
+                       for k in ks}
+        # false > unknown > true, like compose; only definitively-invalid
+        # keys are failures (the reference treats :unknown as truthy,
+        # independent.clj:288-295)
+        valid = merge_valid([r.get("valid?") for r in results.values()])
+        failures = [k for k, r in results.items()
+                    if r.get("valid?") is False]
+        return {"valid?": valid, "results": results, "failures": failures}
+
+    def _check_linearizable_batch(self, model, subs: Dict[Any, List[Op]]
+                                  ) -> Dict[Any, dict]:
+        """One device launch for all keys; unknowns (frontier overflow)
+        and packing failures fall back to the per-key escalating path."""
+        from ..ops.packed import pack_history
+        from . import batch as B
+        from . import linear_jax as LJ
+
+        ks = list(subs)
+        try:
+            packeds = [pack_history(list(subs[k])) for k in ks]
+            pb = B.pack_batch(packeds, model)
+            status, fail_at, _ = B.check_batch(pb, F=self.batch_frontier,
+                                               mesh=self.mesh)
+        except Exception:
+            return {k: check_safe(self.base, {}, model, subs[k], None)
+                    for k in ks}
+        results: Dict[Any, dict] = {}
+        for i, k in enumerate(ks):
+            st = int(status[i])
+            if st == LJ.VALID:
+                results[k] = {"valid?": True, "backend": "device-batch"}
+            else:
+                # invalid or overflow: re-check solo for an exact verdict
+                # with escalation and a decoded counterexample
+                results[k] = check_safe(self.base, {}, model, subs[k], None)
+        return results
+
+
+def checker(base: Checker, **kw) -> IndependentChecker:
+    return IndependentChecker(base, **kw)

@@ -1,0 +1,91 @@
+"""Workload-specific checkers from the comdb2 test suite and Adya.
+
+- :class:`BankChecker` — total-balance invariant over reads
+  (``comdb2/core.clj:152-177``)
+- :class:`DirtyReadsChecker` — a failed write's value must never become
+  visible to a read (``comdb2/core.clj:492-523``)
+- :class:`G2Checker` — Adya G2 anti-dependency cycles: at most one
+  insert may succeed per key (``jepsen/adya.clj:57-83``)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .checkers import Checker
+from .independent import is_tuple
+
+
+class BankChecker(Checker):
+    """Balances must all be present and sum to the model's total. The
+    model here is a plain dict ``{"n": accounts, "total": sum}``
+    (``comdb2/core.clj:152-177``)."""
+
+    def check(self, test, model, history, opts=None):
+        n = model["n"]
+        total = model["total"]
+        bad_reads = []
+        for op in history:
+            if op.type != "ok" or op.f != "read" or op.value is None:
+                continue
+            balances = list(op.value)
+            if len(balances) != n:
+                bad_reads.append({"type": "wrong-n", "expected": n,
+                                  "found": len(balances), "op": op})
+            elif sum(balances) != total:
+                bad_reads.append({"type": "wrong-total", "expected": total,
+                                  "found": sum(balances), "op": op})
+        return {"valid?": not bad_reads, "bad-reads": bad_reads}
+
+
+bank_checker = BankChecker()
+
+
+class DirtyReadsChecker(Checker):
+    """Looks for a failed write's value visible to some read; also
+    reports reads whose per-node values disagree
+    (``comdb2/core.clj:492-523``: read values are sequences of the row
+    as seen from each node)."""
+
+    def check(self, test, model, history, opts=None):
+        failed_writes = {op.value for op in history
+                         if op.type == "fail" and op.f == "write"}
+        reads = [op.value for op in history
+                 if op.type == "ok" and op.f == "read"
+                 and op.value is not None]
+        inconsistent = [v for v in reads if len(set(v)) > 1]
+        filthy = [v for v in reads if any(x in failed_writes for x in v)]
+        return {"valid?": not filthy,
+                "inconsistent-reads": inconsistent,
+                "dirty-reads": filthy}
+
+
+dirty_reads_checker = DirtyReadsChecker()
+
+
+class G2Checker(Checker):
+    """At most one :insert completes successfully for any given key.
+    Op values are ``(key, [a-id, b-id])`` tuples from the independent
+    generator (``adya.clj:57-83``)."""
+
+    def check(self, test, model, history, opts=None):
+        counts: Dict[Any, int] = {}
+        for op in history:
+            if op.f != "insert" or op.value is None:
+                continue
+            v = op.value
+            k = v.key if is_tuple(v) else v[0]
+            counts.setdefault(k, 0)
+            if op.type == "ok":
+                counts[k] += 1
+        insert_count = sum(1 for c in counts.values() if c > 0)
+        illegal = {k: c for k, c in sorted(counts.items(), key=repr)
+                   if c > 1}
+        return {"valid?": not illegal,
+                "key-count": len(counts),
+                "legal-count": insert_count - len(illegal),
+                "illegal-count": len(illegal),
+                "illegal": illegal}
+
+
+g2_checker = G2Checker()

@@ -1,0 +1,276 @@
+"""Checker-layer tests (jepsen/checker.clj semantics)."""
+
+import pytest
+
+from comdb2_tpu.checker import checkers as C
+from comdb2_tpu.checker import independent as I
+from comdb2_tpu.checker import workloads as W
+from comdb2_tpu.models import model as M
+from comdb2_tpu.ops.op import invoke, ok, fail, info, Op
+from comdb2_tpu.utils.intervals import integer_interval_set_str, fraction
+
+
+TEST = {"name": "t"}
+
+
+# --- merge-valid / compose --------------------------------------------------
+
+def test_merge_valid_priority():
+    assert C.merge_valid([True, True]) is True
+    assert C.merge_valid([True, "unknown"]) == "unknown"
+    assert C.merge_valid([True, "unknown", False]) is False
+    assert C.merge_valid([]) is True
+
+
+def test_compose_runs_all_and_merges():
+    class Always:
+        def __init__(self, v):
+            self.v = v
+
+        def check(self, test, model, history, opts=None):
+            return {"valid?": self.v}
+
+    c = C.compose({"a": Always(True), "b": Always(False),
+                   "c": Always("unknown")})
+    r = c.check(TEST, None, [])
+    assert r["valid?"] is False
+    assert r["a"]["valid?"] is True
+    assert r["b"]["valid?"] is False
+
+
+def test_check_safe_wraps_exceptions():
+    class Boom(C.Checker):
+        def check(self, test, model, history, opts=None):
+            raise RuntimeError("kaboom")
+
+    r = C.check_safe(Boom(), TEST, None, [])
+    assert r["valid?"] == "unknown"
+    assert "kaboom" in r["error"]
+
+
+# --- linearizable -----------------------------------------------------------
+
+def test_linearizable_checker_valid():
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", 1), ok(1, "read", 1)]
+    r = C.linearizable.check(TEST, M.register(), h)
+    assert r["valid?"] is True
+
+
+def test_linearizable_checker_invalid():
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", None), ok(1, "read", 2)]
+    r = C.linearizable.check(TEST, M.register(), h)
+    assert r["valid?"] is False
+    assert len(r["configs"]) <= 10
+
+
+# --- set --------------------------------------------------------------------
+
+def _set_history(adds_ok, adds_fail, adds_info, read):
+    h = []
+    for v in adds_ok:
+        h += [invoke(0, "add", v), ok(0, "add", v)]
+    for v in adds_fail:
+        h += [invoke(0, "add", v), fail(0, "add", v)]
+    for v in adds_info:
+        h += [invoke(0, "add", v), info(0, "add", v)]
+    h += [invoke(1, "read", None), ok(1, "read", frozenset(read))]
+    return h
+
+
+def test_set_checker_ok():
+    r = C.set_checker.check(TEST, None, _set_history([1, 2], [3], [], [1, 2]))
+    assert r["valid?"] is True
+    assert r["ok"] == "#{1..2}"
+    assert r["lost"] == "#{}"
+
+
+def test_set_checker_lost_and_unexpected():
+    r = C.set_checker.check(TEST, None, _set_history([1, 2], [], [], [2, 9]))
+    assert r["valid?"] is False
+    assert r["lost"] == "#{1}"
+    assert r["unexpected"] == "#{9}"
+
+
+def test_set_checker_recovered():
+    # indeterminate add that shows up in the read: recovered, valid
+    r = C.set_checker.check(TEST, None, _set_history([1], [], [5], [1, 5]))
+    assert r["valid?"] is True
+    assert r["recovered"] == "#{5}"
+    assert r["recovered-frac"] == fraction(1, 2)
+
+
+def test_set_checker_never_read():
+    r = C.set_checker.check(TEST, None, [invoke(0, "add", 1),
+                                         ok(0, "add", 1)])
+    assert r["valid?"] == "unknown"
+
+
+# --- queue / total-queue ----------------------------------------------------
+
+def test_queue_checker_valid():
+    h = [invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+         invoke(1, "dequeue", 1), ok(1, "dequeue", 1)]
+    r = C.queue.check(TEST, M.unordered_queue(), h)
+    assert r["valid?"] is True
+
+
+def test_queue_checker_dequeue_from_nowhere():
+    h = [invoke(1, "dequeue", None), ok(1, "dequeue", 9)]
+    r = C.queue.check(TEST, M.unordered_queue(), h)
+    assert r["valid?"] is False
+
+
+def test_total_queue_lost_and_unexpected():
+    h = [invoke(0, "enqueue", 1), ok(0, "enqueue", 1),       # lost
+         invoke(0, "enqueue", 2), ok(0, "enqueue", 2),
+         invoke(1, "dequeue", None), ok(1, "dequeue", 2),
+         invoke(1, "dequeue", None), ok(1, "dequeue", 7)]    # unexpected
+    r = C.total_queue.check(TEST, None, h)
+    assert r["valid?"] is False
+    assert r["lost"] == {1: 1}
+    assert r["unexpected"] == {7: 1}
+
+
+def test_total_queue_duplicated_and_recovered():
+    h = [invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+         invoke(0, "enqueue", 3), info(0, "enqueue", 3),     # indeterminate
+         invoke(1, "dequeue", None), ok(1, "dequeue", 1),
+         invoke(1, "dequeue", None), ok(1, "dequeue", 1),    # duplicate
+         invoke(1, "dequeue", None), ok(1, "dequeue", 3)]    # recovered
+    r = C.total_queue.check(TEST, None, h)
+    assert r["duplicated"] == {1: 1}
+    assert r["recovered"] == {3: 1}
+
+
+# --- counter ----------------------------------------------------------------
+
+def test_counter_in_bounds():
+    h = [invoke(0, "add", 1), ok(0, "add", 1),
+         invoke(1, "read", None), ok(1, "read", 1),
+         invoke(0, "add", 2), info(0, "add", 2),   # maybe applied
+         invoke(1, "read", None), ok(1, "read", 3),
+         invoke(2, "read", None), ok(2, "read", 1)]
+    r = C.counter.check(TEST, None, h)
+    assert r["valid?"] is True
+    assert (1, 1, 1) in r["reads"]
+
+
+def test_counter_out_of_bounds():
+    h = [invoke(0, "add", 1), ok(0, "add", 1),
+         invoke(1, "read", None), ok(1, "read", 5)]
+    r = C.counter.check(TEST, None, h)
+    assert r["valid?"] is False
+    assert r["errors"] == [(1, 5, 1)]
+
+
+# --- independent ------------------------------------------------------------
+
+def _keyed(k, v):
+    return I.tuple_(k, v)
+
+
+def test_subhistory_unwraps_and_keeps_unkeyed():
+    h = [invoke(0, "write", _keyed(1, 5)), ok(0, "write", _keyed(1, 5)),
+         info("nemesis", "start", None),
+         invoke(1, "write", _keyed(2, 7)), ok(1, "write", _keyed(2, 7))]
+    sub = I.subhistory(1, h)
+    assert [op.value for op in sub] == [5, 5, None]
+    assert I.history_keys(h) == [1, 2]
+
+
+def test_independent_checker_all_valid():
+    h = []
+    for k in range(4):
+        h += [invoke(k, "write", _keyed(k, 1)), ok(k, "write", _keyed(k, 1)),
+              invoke(k, "read", None), ok(k, "read", _keyed(k, 1))]
+    c = I.checker(C.Linearizable())
+    r = c.check(TEST, M.register(), h)
+    assert r["valid?"] is True
+    assert r["failures"] == []
+    assert set(r["results"]) == {0, 1, 2, 3}
+
+
+def test_independent_checker_finds_bad_key():
+    h = []
+    for k in range(3):
+        h += [invoke(k, "write", _keyed(k, 1)), ok(k, "write", _keyed(k, 1))]
+    # key 2 reads a value never written
+    h += [invoke(3, "read", None), ok(3, "read", _keyed(2, 9))]
+    c = I.checker(C.Linearizable())
+    r = c.check(TEST, M.register(), h)
+    assert r["valid?"] is False
+    assert r["failures"] == [2]
+    assert r["results"][2]["valid?"] is False
+    assert r["results"][0]["valid?"] is True
+
+
+def test_independent_checker_unknown_is_not_failure():
+    class AlwaysUnknown(C.Checker):
+        def check(self, test, model, history, opts=None):
+            return {"valid?": "unknown"}
+
+    h = [invoke(0, "write", _keyed(1, 5)), ok(0, "write", _keyed(1, 5))]
+    r = I.checker(AlwaysUnknown()).check(TEST, None, h)
+    assert r["valid?"] == "unknown"
+    assert r["failures"] == []
+
+
+def test_wrap_keyed_history():
+    h = [invoke(0, "write", (1, 5))]
+    w = I.wrap_keyed_history(h)
+    assert I.is_tuple(w[0].value)
+    assert w[0].value.key == 1
+
+
+# --- workloads --------------------------------------------------------------
+
+def test_bank_checker():
+    model = {"n": 2, "total": 10}
+    good = [invoke(0, "read", None), ok(0, "read", (4, 6))]
+    bad = [invoke(0, "read", None), ok(0, "read", (4, 5))]
+    assert W.bank_checker.check(TEST, model, good)["valid?"] is True
+    r = W.bank_checker.check(TEST, model, bad)
+    assert r["valid?"] is False
+    assert r["bad-reads"][0]["type"] == "wrong-total"
+    short = [invoke(0, "read", None), ok(0, "read", (10,))]
+    assert W.bank_checker.check(TEST, model, short)["bad-reads"][0]["type"] \
+        == "wrong-n"
+
+
+def test_dirty_reads_checker():
+    h = [invoke(0, "write", 3), fail(0, "write", 3),
+         invoke(1, "read", None), ok(1, "read", (3, 3, 3))]
+    r = W.dirty_reads_checker.check(TEST, None, h)
+    assert r["valid?"] is False
+    assert r["dirty-reads"] == [(3, 3, 3)]
+    h2 = [invoke(0, "write", 3), ok(0, "write", 3),
+          invoke(1, "read", None), ok(1, "read", (3, 4, 3))]
+    r2 = W.dirty_reads_checker.check(TEST, None, h2)
+    assert r2["valid?"] is True
+    assert r2["inconsistent-reads"] == [(3, 4, 3)]
+
+
+def test_g2_checker():
+    h = [invoke(0, "insert", _keyed(1, (10, None))),
+         ok(0, "insert", _keyed(1, (10, None))),
+         invoke(1, "insert", _keyed(1, (None, 11))),
+         fail(1, "insert", _keyed(1, (None, 11))),
+         invoke(0, "insert", _keyed(2, (12, None))),
+         ok(0, "insert", _keyed(2, (12, None))),
+         invoke(1, "insert", _keyed(2, (None, 13))),
+         ok(1, "insert", _keyed(2, (None, 13)))]
+    r = W.g2_checker.check(TEST, None, h)
+    assert r["valid?"] is False
+    assert r["illegal"] == {2: 2}
+    assert r["key-count"] == 2
+    assert r["legal-count"] == 1
+
+
+# --- intervals --------------------------------------------------------------
+
+def test_integer_interval_set_str():
+    assert integer_interval_set_str({1, 2, 3, 5, 9, 10}) == "#{1..3 5 9..10}"
+    assert integer_interval_set_str(set()) == "#{}"
+    assert integer_interval_set_str({7}) == "#{7}"
