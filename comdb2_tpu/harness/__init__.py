@@ -1,0 +1,16 @@
+"""Harness runtime: generator DSL, client/DB/OS protocols, worker &
+nemesis loops with indeterminacy-driven process recycling, results
+store, and CLI — the capabilities of ``jepsen/{core,generator,client,
+db,os,store,cli,tests}.clj``."""
+
+from . import generator
+from . import client
+from . import db
+from . import core
+from . import store
+from . import fake
+from . import cli
+from .core import run, run_case
+
+__all__ = ["generator", "client", "db", "core", "store", "fake", "cli",
+           "run", "run_case"]

@@ -1,0 +1,98 @@
+"""Counterexample SVG for failed linearizability analyses.
+
+The role of ``knossos/linear/report.clj`` (``render-analysis!``,
+``report.clj:629``): a process/time grid of the operations surrounding
+the point where the frontier died, the crashing op highlighted, and the
+surviving frontier's model states at death listed alongside. Rendered on
+a rank-based (time-warped) x axis like the reference, so dense regions
+stay readable."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from ..ops.op import Op
+from .svg import SVG
+
+BAR = {"ok": "#B7FFB7", "fail": "#FFD4D5", "info": "#FEFFC1",
+       None: "#C1DEFF"}
+ROW_H = 22
+WINDOW = 40  # ops of context on each side of the failure
+
+
+def render_analysis(history: Sequence[Op], analysis,
+                    path: Optional[str] = None) -> str:
+    """``analysis`` is a :class:`comdb2_tpu.checker.linear.Analysis`
+    (or any object with ``op_index`` and ``configs``)."""
+    ops = list(history)
+    fail_at = getattr(analysis, "op_index", None)
+    lo = max(0, (fail_at or 0) - WINDOW)
+    hi = min(len(ops), (fail_at or 0) + WINDOW)
+    window = ops[lo:hi]
+
+    # pair invocations with completions inside the window
+    spans = []      # (process, f, value, start-rank, end-rank, type)
+    inflight = {}
+    for rank, op in enumerate(window):
+        if op.type == "invoke":
+            inflight[op.process] = (rank, op)
+        elif op.process in inflight:
+            r0, inv = inflight.pop(op.process)
+            spans.append((op.process, inv.f, inv.value, r0, rank, op.type))
+    for p, (r0, inv) in inflight.items():
+        spans.append((p, inv.f, inv.value, r0, len(window), None))
+
+    procs = sorted({s[0] for s in spans}, key=repr)
+    prow = {p: i for i, p in enumerate(procs)}
+    n = max(len(window), 1)
+
+    width, left = 980, 90
+    lane = (width - left - 240) / n
+    height = 60 + ROW_H * max(len(procs), 1) + 16 * 12
+    svg = SVG(width, int(height))
+    svg.text(width / 2, 16, "linearizability counterexample", size=13,
+             anchor="middle")
+
+    for p in procs:
+        y = 40 + prow[p] * ROW_H
+        svg.text(8, y + ROW_H / 2 + 3, f"proc {p}", size=10)
+        svg.line(left, y + ROW_H / 2, width - 240, y + ROW_H / 2,
+                 stroke="#eee")
+
+    fail_rank = (fail_at - lo) if fail_at is not None else None
+    for (p, f, value, r0, r1, typ) in spans:
+        y = 40 + prow[p] * ROW_H + 2
+        x0 = left + r0 * lane
+        w = max((r1 - r0) * lane, 3)
+        crashing = fail_rank is not None and r0 <= fail_rank <= r1 \
+            and typ == "ok"
+        svg.rect(x0, y, w, ROW_H - 6,
+                 fill=BAR.get(typ, "#C1DEFF"),
+                 stroke="#c0392b" if crashing else "#999",
+                 title=f"{p} {f} {value!r} -> {typ or 'pending'}")
+        label = f"{f} {value!r}" if value is not None else str(f)
+        svg.text(x0 + 2, y + ROW_H - 10, label[: max(int(w / 6), 4)],
+                 size=9)
+
+    if fail_rank is not None:
+        x = left + (fail_rank + 0.5) * lane
+        svg.line(x, 32, x, 40 + ROW_H * len(procs), stroke="#c0392b",
+                 width=1.5, dash="4,3")
+        svg.text(x, 30, "frontier died here", size=9, fill="#c0392b",
+                 anchor="middle")
+
+    y = 52 + ROW_H * max(len(procs), 1)
+    svg.text(left, y, "surviving configs at death:", size=10)
+    configs = list(getattr(analysis, "configs", []) or [])[:10]
+    for i, cfg in enumerate(configs):
+        svg.text(left, y + 14 + 13 * i, f"  {cfg}", size=9, fill="#444")
+    if not configs:
+        svg.text(left, y + 14, "  (none recorded)", size=9, fill="#444")
+
+    out = svg.render()
+    if path:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(out)
+    return out

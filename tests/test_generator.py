@@ -1,0 +1,171 @@
+"""Generator DSL tests (jepsen/generator.clj semantics)."""
+
+import threading
+import time
+
+from comdb2_tpu.harness import generator as G
+
+TEST = {"concurrency": 4, "nodes": ["a", "b"]}
+
+
+def test_constant_generators():
+    # any object is a constant generator of itself; None terminates
+    assert G.op({"type": "invoke", "f": "read"}, TEST, 0)["f"] == "read"
+    assert G.op(None, TEST, 0) is None
+    assert G.op(G.void, TEST, 0) is None
+
+
+def test_fn_generator():
+    assert G.op(lambda t, p: {"f": p}, TEST, 3)["f"] == 3
+    assert G.op(lambda: {"f": "x"}, TEST, 0)["f"] == "x"
+
+
+def test_process_to_thread_and_node():
+    assert G.process_to_thread(TEST, 6) == 2      # 6 mod 4
+    assert G.process_to_thread(TEST, "nemesis") == "nemesis"
+    assert G.process_to_node(TEST, 5) == "b"      # thread 1 -> nodes[1]
+    assert G.process_to_node(TEST, "nemesis") is None
+
+
+def test_once():
+    g = G.once({"f": "x"})
+    assert G.op(g, TEST, 0) == {"f": "x"}
+    assert G.op(g, TEST, 1) is None
+
+
+def test_seq_moves_past_exhausted():
+    g = G.seq([G.void, {"f": "a"}, {"f": "b"}])
+    # constant generators repeat forever, so seq sticks on "a" until
+    # asked again... no: seq draws one op per element then advances
+    assert G.op(g, TEST, 0)["f"] == "a"
+    assert G.op(g, TEST, 0)["f"] == "b"
+    assert G.op(g, TEST, 0) is None
+
+
+def test_limit():
+    g = G.limit(2, {"f": "x"})
+    assert G.op(g, TEST, 0) is not None
+    assert G.op(g, TEST, 0) is not None
+    assert G.op(g, TEST, 0) is None
+
+
+def test_time_limit():
+    g = G.time_limit(0.05, {"f": "x"})
+    assert G.op(g, TEST, 0) is not None
+    time.sleep(0.08)
+    assert G.op(g, TEST, 0) is None
+
+
+def test_mix_uniform():
+    g = G.mix([{"f": "a"}, {"f": "b"}])
+    seen = {G.op(g, TEST, 0)["f"] for _ in range(50)}
+    assert seen == {"a", "b"}
+
+
+def test_filter():
+    src = G.seq([{"f": "a"}, {"f": "b"}, {"f": "a"}])
+    g = G.filter_gen(lambda o: o["f"] == "a", src)
+    assert G.op(g, TEST, 0)["f"] == "a"
+    assert G.op(g, TEST, 0)["f"] == "a"   # skips b
+    assert G.op(g, TEST, 0) is None
+
+
+def test_on_routes_by_thread():
+    g = G.on(lambda t: t == G.NEMESIS, {"f": "boom"})
+    with G.with_threads([G.NEMESIS, 0, 1, 2, 3]):
+        assert G.op(g, TEST, "nemesis")["f"] == "boom"
+        assert G.op(g, TEST, 0) is None
+        assert G.op(g, TEST, 5) is None
+
+
+def test_nemesis_and_clients_split():
+    g = G.nemesis({"f": "n"}, {"f": "c"})
+    with G.with_threads([G.NEMESIS, 0, 1, 2, 3]):
+        assert G.op(g, TEST, "nemesis")["f"] == "n"
+        assert G.op(g, TEST, 2)["f"] == "c"
+    gc = G.clients({"f": "c"})
+    with G.with_threads([G.NEMESIS, 0, 1, 2, 3]):
+        assert G.op(gc, TEST, "nemesis") is None
+        assert G.op(gc, TEST, 1)["f"] == "c"
+
+
+def test_reserve_partitions_threads():
+    g = G.reserve(2, {"f": "w"}, 1, {"f": "c"}, {"f": "r"})
+    with G.with_threads([0, 1, 2, 3]):
+        assert G.op(g, TEST, 0)["f"] == "w"
+        assert G.op(g, TEST, 1)["f"] == "w"
+        assert G.op(g, TEST, 2)["f"] == "c"
+        assert G.op(g, TEST, 3)["f"] == "r"
+
+
+def test_concat_first_non_nil():
+    g = G.concat(G.void, {"f": "x"})
+    assert G.op(g, TEST, 0)["f"] == "x"
+
+
+def test_each_per_process():
+    g = G.each(lambda: G.limit(1, {"f": "x"}))
+    assert G.op(g, TEST, 0) is not None
+    assert G.op(g, TEST, 1) is not None   # fresh copy for process 1
+    assert G.op(g, TEST, 0) is None       # process 0's copy exhausted
+
+
+def test_queue_gen_and_drain():
+    g = G.drain_queue(G.limit(20, G.queue_gen()))
+    enq = deq = 0
+    while True:
+        o = G.op(g, TEST, 0)
+        if o is None:
+            break
+        if o["f"] == "enqueue":
+            enq += 1
+        else:
+            deq += 1
+    assert deq >= enq
+
+
+def test_synchronize_barrier():
+    g = G.synchronize({"f": "x"})
+    results = []
+    def draw():
+        with G.with_threads([0, 1]):
+            results.append(G.op(g, {"concurrency": 2}, 0))
+    t1 = threading.Thread(target=draw)
+    t1.start()
+    time.sleep(0.05)
+    assert not results           # blocked on the barrier
+    t2 = threading.Thread(target=draw)
+    t2.start()
+    t1.join(2)
+    t2.join(2)
+    assert len(results) == 2
+
+
+def test_phases_orders_generators():
+    g = G.phases(G.limit(1, {"f": "a"}), G.limit(1, {"f": "b"}))
+    with G.with_threads([0]):
+        assert G.op(g, {"concurrency": 1}, 0)["f"] == "a"
+        assert G.op(g, {"concurrency": 1}, 0)["f"] == "b"
+        assert G.op(g, {"concurrency": 1}, 0) is None
+
+
+def test_stagger_and_sleep_timing():
+    t0 = time.monotonic()
+    assert G.op(G.sleep(0.03), TEST, 0) is None
+    assert time.monotonic() - t0 >= 0.03
+
+
+def test_delay_til_ticks():
+    g = G.delay_til(0.02, {"f": "x"})
+    t0 = time.monotonic()
+    G.op(g, TEST, 0)
+    G.op(g, TEST, 0)
+    # two draws land on two distinct ticks ~0.02s apart
+    assert time.monotonic() - t0 >= 0.02
+
+
+def test_start_stop():
+    g = G.start_stop(0, 0)
+    assert G.op(g, TEST, 0)["f"] == "start"
+    assert G.op(g, TEST, 0)["f"] == "stop"
+    assert G.op(g, TEST, 0) is None

@@ -1,0 +1,228 @@
+"""Harness runtime tests: worker loops, nemesis, process recycling,
+store round-trips, full runs against the atom SUT."""
+
+import os
+
+import pytest
+
+from comdb2_tpu.checker import checkers as C
+from comdb2_tpu.harness import cli, core, fake, store
+from comdb2_tpu.harness import client as client_ns
+from comdb2_tpu.harness import generator as G
+from comdb2_tpu.models import model as M
+from comdb2_tpu.ops.op import Op
+
+
+def _base_test(tmp_path, **kw):
+    t = fake.noop_test()
+    state = fake.Atom()
+    t.update({
+        "nodes": [],
+        "concurrency": 4,
+        "db": fake.atom_db(state),
+        "client": fake.atom_client(state),
+        "model": M.cas_register(),
+        "store-root": str(tmp_path / "store"),
+        "name": "atom-test",
+    })
+    t.update(kw)
+    return t
+
+
+def test_noop_run(tmp_path):
+    t = fake.noop_test()
+    t["store-root"] = str(tmp_path / "store")
+    t["nodes"] = []
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
+    assert result["history"] == []
+
+
+def test_full_run_against_atom_sut(tmp_path):
+    t = _base_test(tmp_path,
+                   generator=G.clients(G.limit(60, G.cas_gen)))
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
+    h = result["history"]
+    assert len(h) >= 120                      # invokes + completions
+    assert {op.type for op in h} <= {"invoke", "ok", "fail"}
+    # single-threaded process discipline: invoke/completion alternate
+    pending = set()
+    for op in h:
+        if op.type == "invoke":
+            assert op.process not in pending
+            pending.add(op.process)
+        else:
+            assert op.process in pending
+            pending.remove(op.process)
+
+
+def test_worker_recycles_process_on_crash(tmp_path):
+    class CrashyClient(client_ns.Client):
+        def __init__(self):
+            self.n = 0
+
+        def setup(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            self.n += 1
+            if self.n == 2:
+                raise RuntimeError("network exploded")
+            return {**op, "type": "ok"}
+
+    t = _base_test(tmp_path, concurrency=1,
+                   client=CrashyClient(),
+                   generator=G.clients(G.limit(3, {"type": "invoke",
+                                                   "f": "read",
+                                                   "value": None})),
+                   checker=C.unbridled_optimism)
+    result = core.run(t)
+    h = result["history"]
+    infos = [op for op in h if op.type == "info"]
+    assert len(infos) == 1
+    assert "indeterminate" in infos[0].extra.get("error", "")
+    # the crashed op's process never appears again; successor is p+concurrency
+    crashed_p = infos[0].process
+    procs_after = {op.process for op in h[h.index(infos[0]) + 1:]}
+    assert crashed_p not in procs_after
+    assert crashed_p + 1 in procs_after
+
+
+def test_nemesis_worker_runs(tmp_path):
+    events = []
+
+    class Nem(client_ns.Client):
+        def invoke(self, test, op):
+            events.append(op["f"])
+            return dict(op)
+
+    t = _base_test(tmp_path,
+                   nemesis=Nem(),
+                   generator=G.nemesis(
+                       G.seq([{"type": "info", "f": "start"},
+                              {"type": "info", "f": "stop"}]),
+                       G.limit(10, G.cas_gen)))
+    result = core.run(t)
+    assert events == ["start", "stop"]
+    nem_ops = [op for op in result["history"] if op.process == "nemesis"]
+    assert len(nem_ops) == 4          # 2 invocations + 2 completions
+    assert result["results"]["valid?"] is True
+
+
+def test_invalid_history_detected(tmp_path):
+    class LyingClient(client_ns.Client):
+        def invoke(self, test, op):
+            if op["f"] == "read":
+                return {**op, "type": "ok", "value": 42}
+            return {**op, "type": "ok"}
+
+    t = _base_test(tmp_path, concurrency=2,
+                   client=LyingClient(),
+                   generator=G.clients(G.limit(
+                       8, G.mix([{"type": "invoke", "f": "write", "value": 1},
+                                 {"type": "invoke", "f": "read",
+                                  "value": None}]))))
+    result = core.run(t)
+    assert result["results"]["valid?"] is False
+
+
+def test_store_round_trip(tmp_path):
+    t = _base_test(tmp_path,
+                   generator=G.clients(G.limit(20, G.cas_gen)))
+    result = core.run(t)
+    assert os.path.exists(store.path(result, "test.edn"))
+    assert os.path.exists(store.path(result, "history.edn"))
+    assert os.path.exists(store.path(result, "results.edn"))
+    assert os.path.exists(store.path(result, "jepsen.log"))
+
+    loaded = store.load("atom-test", result["start-time"],
+                        store_root=result["store-root"])
+    assert len(loaded["history"]) == len(result["history"])
+    # offline re-check from the persisted artifact (store.clj:159-165)
+    recheck = C.linearizable.check(loaded, M.cas_register(),
+                                   loaded["history"])
+    assert recheck["valid?"] is True
+    # latest symlink
+    lat = store.latest("atom-test", store_root=result["store-root"])
+    assert lat is not None and lat["start-time"] == result["start-time"]
+
+
+def test_cli_single_test_cmd(tmp_path):
+    def test_fn(opts):
+        state = fake.Atom()
+        t = fake.noop_test()
+        t.update({
+            "nodes": opts["nodes"],
+            "concurrency": opts["concurrency"],
+            "db": fake.atom_db(state),
+            "client": fake.atom_client(state),
+            "model": M.cas_register(),
+            "generator": G.clients(G.limit(10, G.cas_gen)),
+            "store-root": opts["store-root"],
+            "name": "cli-test",
+        })
+        return t
+
+    rc = cli.single_test_cmd(
+        test_fn, argv=["--concurrency", "2",
+                       "--store-root", str(tmp_path / "store")])
+    assert rc == 0
+
+
+def test_phases_barrier_works_inside_worker_threads(tmp_path):
+    """The canonical set workload: concurrent adds, then one final read.
+    gen.phases must hold the read back until every add thread finishes —
+    this only works if *threads* is bound inside each worker thread."""
+    added = []
+    state_lock = __import__("threading").Lock()
+
+    class SetClient(client_ns.Client):
+        def invoke(self, test, op):
+            if op["f"] == "add":
+                import time
+                time.sleep(0.01)
+                with state_lock:
+                    added.append(op["value"])
+                return {**op, "type": "ok"}
+            with state_lock:
+                return {**op, "type": "ok", "value": frozenset(added)}
+
+    counter = iter(range(10**6))
+    adds = G.limit(24, lambda t, p: {"type": "invoke", "f": "add",
+                                     "value": next(counter)})
+    final_read = G.once({"type": "invoke", "f": "read", "value": None})
+    t = _base_test(tmp_path, concurrency=4,
+                   client=SetClient(),
+                   checker=C.set_checker,
+                   generator=G.clients(G.phases(adds, final_read)))
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+    assert result["results"]["lost"] == "#{}"
+
+
+def test_cli_invalid_dominates_unknown(tmp_path, monkeypatch):
+    verdicts = iter(["unknown", False])
+
+    def fake_run(test):
+        return {"results": {"valid?": next(verdicts)}}
+
+    monkeypatch.setattr(core, "run", fake_run)
+    rc = cli.single_test_cmd(lambda opts: {}, argv=["--test-count", "2"])
+    assert rc == 1
+
+
+def test_on_nodes_parallel_and_errors():
+    calls = []
+
+    def good(test, node):
+        calls.append(node)
+
+    core._on_nodes({"nodes": ["a", "b", "c"]}, good)
+    assert sorted(calls) == ["a", "b", "c"]
+
+    def bad(test, node):
+        raise ValueError(node)
+
+    with pytest.raises(ValueError):
+        core._on_nodes({"nodes": ["a"]}, bad)

@@ -1,0 +1,118 @@
+"""Reporting layer tests: perf math, SVG/HTML artifact generation."""
+
+import os
+import random
+
+from comdb2_tpu.checker import linear
+from comdb2_tpu.models import model as M
+from comdb2_tpu.ops.op import invoke, ok, fail, info, Op
+from comdb2_tpu.ops.synth import register_history
+from comdb2_tpu.report import (perf, timeline, linear_svg, latency_graph,
+                               perf_checker, Timeline)
+
+TEST = {"name": "report-test"}
+SEC = 1_000_000_000
+
+
+def _timed_history():
+    h = []
+    t = 0
+    for i in range(40):
+        p = i % 4
+        t += SEC // 10
+        h.append(invoke(p, "write", i, time=t))
+        t += SEC // 100
+        typ = "ok" if i % 5 else "fail"
+        h.append(Op(p, typ, "write", i, time=t))
+    h.insert(10, Op("nemesis", "info", "start", None, time=SEC))
+    h.insert(30, Op("nemesis", "info", "stop", None, time=3 * SEC))
+    return h
+
+
+def test_history_latencies_pairs():
+    h = [invoke(0, "w", 1, time=100), ok(0, "w", 1, time=350)]
+    ps = perf.history_latencies(h)
+    assert len(ps) == 1
+    assert ps[0][1].time - ps[0][0].time == 250
+
+
+def test_nemesis_intervals():
+    h = [Op("nemesis", "info", "start", None, time=1 * SEC),
+         Op("nemesis", "info", "stop", None, time=2 * SEC),
+         Op("nemesis", "info", "start", None, time=3 * SEC)]
+    iv = perf.nemesis_intervals(h, final_time=5.0)
+    assert iv == [(1.0, 2.0), (3.0, 5.0)]
+
+
+def test_quantiles_floor_semantics():
+    # perf.clj:45-56 — index = floor(n*q), clamped
+    q = perf.quantiles([0.5, 1], [1, 2, 3, 4])
+    assert q[0.5] == 3
+    assert q[1] == 4
+
+
+def test_latencies_to_quantiles_buckets():
+    pts = [(1, 10.0), (2, 20.0), (40, 100.0)]
+    curves = perf.latencies_to_quantiles(30, [1], pts)
+    assert curves[1] == [(15.0, 20.0), (45.0, 100.0)]
+
+
+def test_graphs_produce_svg(tmp_path):
+    h = _timed_history()
+    s1 = perf.point_graph(TEST, h, str(tmp_path / "latency-raw.svg"))
+    s2 = perf.quantiles_graph(TEST, h)
+    s3 = perf.rate_graph(TEST, h)
+    for s in (s1, s2, s3):
+        assert s.startswith("<svg") and s.endswith("</svg>")
+    assert (tmp_path / "latency-raw.svg").exists()
+
+
+def test_perf_checker_writes_artifacts(tmp_path):
+    test = {"name": "t", "dir": str(tmp_path)}
+    r = perf_checker().check(test, None, _timed_history())
+    assert r["valid?"] is True
+    assert (tmp_path / "latency-raw.svg").exists()
+    assert (tmp_path / "latency-quantiles.svg").exists()
+    assert (tmp_path / "rate.svg").exists()
+
+
+def test_timeline_html(tmp_path):
+    h = _timed_history()
+    doc = timeline.html(TEST, h, str(tmp_path / "timeline.html"))
+    assert "<html>" in doc and 'class="op ok"' in doc \
+        and 'class="op fail"' in doc
+    assert (tmp_path / "timeline.html").exists()
+    r = Timeline().check({"name": "t", "dir": str(tmp_path)}, None, h)
+    assert r["valid?"] is True
+
+
+def test_timeline_pairs_unmatched_info():
+    h = [info("nemesis", "start", None), invoke(0, "w", 1), ok(0, "w", 1)]
+    ps = timeline.pairs(h)
+    assert ps[0][1] is None            # singleton info
+    assert ps[1][0].type == "invoke"
+
+
+def test_counterexample_svg(tmp_path):
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", None), ok(1, "read", 2)]
+    a = linear.analysis(M.register(), h)
+    assert a.valid is False
+    svg = linear_svg.render_analysis(h, a, str(tmp_path / "linear.svg"))
+    assert svg.startswith("<svg")
+    assert "frontier died here" in svg
+    assert (tmp_path / "linear.svg").exists()
+
+
+def test_counterexample_svg_large_history_windows():
+    rng = random.Random(5)
+    h = register_history(rng, n_procs=4, n_events=400, p_info=0.0)
+    # corrupt the last ok to make it invalid near the end
+    for i in range(len(h) - 1, -1, -1):
+        if h[i].type == "ok" and h[i].f == "read":
+            h[i] = h[i].with_(value=99)
+            break
+    a = linear.analysis(M.cas_register(), h)
+    assert a.valid is False
+    svg = linear_svg.render_analysis(h, a)
+    assert svg.startswith("<svg")
