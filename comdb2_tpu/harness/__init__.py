@@ -10,7 +10,10 @@ from . import core
 from . import store
 from . import fake
 from . import cli
+from . import nemesis
+from . import nemesis_time
+from . import cluster
 from .core import run, run_case
 
 __all__ = ["generator", "client", "db", "core", "store", "fake", "cli",
-           "run", "run_case"]
+           "nemesis", "nemesis_time", "cluster", "run", "run_case"]

@@ -159,25 +159,13 @@ def _nemesis_loop(test, g, nemesis, history, clock):
 
 
 def _on_nodes(test: dict, f: Callable[[dict, Any], None]) -> None:
-    """Apply f(test, node) to every node in parallel
-    (``control.clj:310-319``)."""
-    nodes = test.get("nodes") or []
-    if not nodes:
-        return
-    errs: List[BaseException] = []
-    def run1(n):
-        try:
-            f(test, n)
-        except BaseException as e:
-            errs.append(e)
-    threads = [threading.Thread(target=run1, args=(n,), daemon=True)
-               for n in nodes]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    if errs:
-        raise errs[0]
+    """Apply f(test, node) to every node in parallel, with each thread
+    bound to that node's control session so DB/OS implementations can
+    call control.exec_/su directly (``control.clj:310-319``)."""
+    from .. import control
+
+    if test.get("nodes"):
+        control.on_nodes(test, f)
 
 
 def run_case(test: dict) -> List[Op]:
@@ -202,7 +190,7 @@ def run_case(test: dict) -> List[Op]:
                 pass
         raise
 
-    nemesis = test.get("nemesis", client_ns.noop).setup(test, None)
+    nemesis = test.get("nemesis", client_ns.noop_nemesis).setup(test, None)
     try:
         nem_thread = threading.Thread(
             target=nemesis_worker, args=(test, nemesis, history, clock),

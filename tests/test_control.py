@@ -1,0 +1,334 @@
+"""Control plane tests: sessions, escaping, parallel exec, net,
+nemesis grudges and fault routing — all against recording/local
+transports (no cluster required)."""
+
+import threading
+
+import pytest
+
+from comdb2_tpu import control
+from comdb2_tpu.control import net as net_ns
+from comdb2_tpu.control import util as cutil
+from comdb2_tpu.control.remote import ExecResult, LocalRemote, RecordingRemote
+from comdb2_tpu.harness import nemesis as N
+
+
+# --- command building -------------------------------------------------------
+
+def test_escape_and_build():
+    assert control.build_cmd("echo", "hi there") == "echo 'hi there'"
+    assert control.build_cmd("ls", "-l") == "ls -l"
+    assert control.build_cmd("echo", control.lit("a && b")) == "echo a && b"
+    assert control.escape(["a", "b c"]) == "a 'b c'"
+    assert control.escape("") == "''"
+
+
+def test_session_wrap_sudo_and_cd():
+    s = control.Session("h", RecordingRemote(), sudo="root", cwd="/tmp")
+    cmd = s.wrap("ls -l")
+    assert cmd == "sudo -S -u root sh -c 'cd /tmp && ls -l'"
+
+
+# --- exec over transports ---------------------------------------------------
+
+def test_local_remote_exec():
+    s = control.Session("localhost", LocalRemote())
+    with control.with_session(s):
+        assert control.exec_("echo", "hello") == "hello"
+        with pytest.raises(control.RemoteError):
+            control.exec_("false")
+        assert control.exec_("false", check=False) == ""
+
+
+def test_exec_requires_session():
+    with pytest.raises(RuntimeError, match="no control session"):
+        control.exec_("echo", "x")
+
+
+def test_on_nodes_binds_per_thread_sessions():
+    rec = RecordingRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": rec}
+    hosts = {}
+
+    def f(test_, node):
+        hosts[node] = control.current_session().host
+        control.exec_("hostname")
+        return node.upper()
+
+    results = control.on_nodes(test, f)
+    assert results == {"n1": "N1", "n2": "N2", "n3": "N3"}
+    assert hosts == {"n1": "n1", "n2": "n2", "n3": "n3"}
+    assert sorted(h for h, _ in rec.commands) == ["n1", "n2", "n3"]
+
+
+def test_su_runs_as_root():
+    rec = RecordingRemote()
+    with control.on("h", rec):
+        control.su("whoami")
+    assert rec.commands[0][1].startswith("sudo -S -u root")
+
+
+def test_control_util_helpers():
+    rec = RecordingRemote(
+        responder=lambda h, c: ExecResult(0, "/tmp/tmp.X", "")
+        if "mktemp" in c else None)
+    with control.on("h", rec):
+        assert cutil.tmp_dir() == "/tmp/tmp.X"
+        assert cutil.exists("/etc/hosts") is True
+        cutil.grepkill("myproc")
+    cmds = [c for _, c in rec.commands]
+    assert any("test -e /etc/hosts" in c for c in cmds)
+    assert any("pkill -KILL -f myproc" in c for c in cmds)
+
+
+# --- net --------------------------------------------------------------------
+
+def _ip_responder(host, cmd):
+    if cmd.startswith("getent hosts"):
+        name = cmd.split()[-1]
+        return ExecResult(0, f"10.0.0.{name[-1]} {name}", "")
+    return None
+
+
+def test_iptables_drop_and_heal():
+    rec = RecordingRemote(responder=_ip_responder)
+    test = {"nodes": ["n1", "n2"], "remote": rec}
+    net = net_ns.IptablesNet()
+    net.drop(test, "n1", "n2")
+    cmds = [(h, c) for h, c in rec.commands if "iptables" in c]
+    assert len(cmds) == 1
+    host, cmd = cmds[0]
+    assert host == "n2"
+    assert "iptables -A INPUT -s 10.0.0.1 -j DROP -w" in cmd
+
+    rec.commands.clear()
+    net.heal(test)
+    heals = [(h, c) for h, c in rec.commands if "iptables -F" in c]
+    assert {h for h, _ in heals} == {"n1", "n2"}
+
+
+def test_net_slow_flaky_fast():
+    rec = RecordingRemote()
+    test = {"nodes": ["n1"], "remote": rec}
+    net = net_ns.IptablesNet()
+    net.slow(test)
+    net.flaky(test)
+    net.fast(test)
+    cmds = [c for _, c in rec.commands]
+    assert any("netem delay 50ms 10ms distribution normal" in c
+               for c in cmds)
+    assert any("netem loss 20% 75%" in c for c in cmds)
+    assert any("qdisc del dev eth0 root" in c for c in cmds)
+
+
+# --- grudges ----------------------------------------------------------------
+
+def test_bisect_and_split_one():
+    assert N.bisect([1, 2, 3, 4, 5]) == [[1, 2], [3, 4, 5]]
+    loner, rest = N.split_one([1, 2, 3], loner=2)
+    assert loner == [2] and rest == [1, 3]
+
+
+def test_complete_grudge():
+    g = N.complete_grudge([[1, 2], [3, 4, 5]])
+    assert g[1] == {3, 4, 5}
+    assert g[4] == {1, 2}
+    assert len(g) == 5
+
+
+def test_bridge_grudge():
+    g = N.bridge([1, 2, 3, 4, 5])
+    # node 3 is the bridge: snubs nobody, nobody snubs it
+    assert 3 not in g
+    assert all(3 not in s for s in g.values())
+    assert g[1] == {4, 5}
+    assert g[4] == {1, 2}
+
+
+def test_majorities_ring_invariants():
+    nodes = [1, 2, 3, 4, 5]
+    g = N.majorities_ring(nodes)
+    assert set(g) == set(nodes)
+    seen_majorities = set()
+    for n, dropped in g.items():
+        visible = set(nodes) - dropped
+        assert n in visible
+        assert len(visible) >= N.majority(len(nodes))
+        seen_majorities.add(frozenset(visible))
+    # no two nodes see the same majority
+    assert len(seen_majorities) == len(nodes)
+
+
+# --- partitioner / nemesis clients ------------------------------------------
+
+def test_partitioner_start_stop():
+    rec = RecordingRemote(responder=_ip_responder)
+    test = {"nodes": ["n1", "n2", "n3", "n4"], "remote": rec,
+            "net": net_ns.IptablesNet()}
+    nem = N.partition_halves().setup(test, None)
+    rec.commands.clear()
+    r = nem.invoke(test, {"type": "info", "f": "start", "value": None})
+    assert r["type"] == "info" and "Cut off" in r["value"]
+    drops = [c for _, c in rec.commands if "-j DROP" in c]
+    # complete grudge between {n1,n2} and {n3,n4}: 2*2 directed pairs,
+    # each dropped at the destination => 8 rules
+    assert len(drops) == 8
+    rec.commands.clear()
+    r = nem.invoke(test, {"type": "info", "f": "stop", "value": None})
+    assert r["value"] == "fully connected"
+    assert any("iptables -F" in c for _, c in rec.commands)
+
+
+def test_compose_routes_and_renames():
+    class Recorder(N.client_ns.Client):
+        def __init__(self):
+            self.fs = []
+
+        def invoke(self, test, op):
+            self.fs.append(op["f"])
+            return dict(op)
+
+    a, b = Recorder(), Recorder()
+    nem = N.compose([(frozenset({"start", "stop"}), a),
+                     ({"kill-start": "start"}, b)])
+    nem.invoke({}, {"type": "info", "f": "start"})
+    out = nem.invoke({}, {"type": "info", "f": "kill-start"})
+    assert a.fs == ["start"]
+    assert b.fs == ["start"]          # renamed on the way in
+    assert out["f"] == "kill-start"   # restored on the way out
+    with pytest.raises(ValueError):
+        nem.invoke({}, {"type": "info", "f": "nope"})
+
+
+def test_hammer_time_stop_cont():
+    rec = RecordingRemote()
+    test = {"nodes": ["n1", "n2"], "remote": rec}
+    nem = N.hammer_time("comdb2", targeter=lambda ns: ns[0])
+    r = nem.invoke(test, {"type": "info", "f": "start", "value": None})
+    assert r["value"] == {"n1": ["paused", "comdb2"]}
+    assert any("killall -s STOP comdb2" in c for h, c in rec.commands
+               if h == "n1")
+    r2 = nem.invoke(test, {"type": "info", "f": "start", "value": None})
+    assert "already disrupting" in r2["value"]
+    r3 = nem.invoke(test, {"type": "info", "f": "stop", "value": None})
+    assert r3["value"] == {"n1": ["resumed", "comdb2"]}
+    r4 = nem.invoke(test, {"type": "info", "f": "stop", "value": None})
+    assert r4["value"] == "not-started"
+
+
+def test_clock_scrambler_sets_dates():
+    rec = RecordingRemote()
+    test = {"nodes": ["n1", "n2"], "remote": rec}
+    nem = N.clock_scrambler(60)
+    r = nem.invoke(test, {"type": "info", "f": "scramble", "value": None})
+    assert set(r["value"]) == {"n1", "n2"}
+    assert all("date +%s -s" in c for _, c in rec.commands)
+    nem.teardown(test)
+
+
+def test_full_run_with_partition_nemesis(tmp_path):
+    """Phase-5 integration: a real harness run over the atom SUT where
+    the nemesis partitions 'nodes' through the recording transport."""
+    from comdb2_tpu.harness import core, fake
+    from comdb2_tpu.harness import generator as G
+    from comdb2_tpu.models import model as M
+
+    rec = RecordingRemote(responder=_ip_responder)
+    state = fake.Atom()
+    t = fake.noop_test()
+    t.update({
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "name": "partition-run",
+        "store-root": str(tmp_path / "store"),
+        "remote": rec,
+        "net": net_ns.IptablesNet(),
+        "db": fake.atom_db(state),
+        "client": fake.atom_client(state),
+        "model": M.cas_register(),
+        "nemesis": N.partition_random_halves(),
+        "generator": G.nemesis(
+            G.seq([{"type": "info", "f": "start", "value": None},
+                   {"type": "info", "f": "stop", "value": None}]),
+            G.limit(40, G.cas_gen)),
+    })
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
+    nem_ops = [op for op in result["history"] if op.process == "nemesis"]
+    assert len(nem_ops) == 4
+    assert any("Cut off" in str(op.value) for op in nem_ops)
+    assert any("-j DROP" in c for _, c in rec.commands)
+    assert any("iptables -F" in c for _, c in rec.commands)
+
+
+def test_db_setup_can_use_control_api(tmp_path):
+    """core.run's node lifecycle must bind control sessions so DB/OS
+    implementations can call control.exec_/su directly."""
+    from comdb2_tpu.harness import core, db as db_ns, fake
+    from comdb2_tpu.harness import generator as G
+    from comdb2_tpu.models import model as M
+
+    rec = RecordingRemote()
+
+    class ShellDB(db_ns.DB):
+        def setup(self, test, node):
+            control.su("systemctl", "start", "mydb")
+
+        def teardown(self, test, node):
+            control.su("systemctl", "stop", "mydb")
+
+    state = fake.Atom()
+    t = fake.noop_test()
+    t.update({"nodes": ["n1", "n2"], "concurrency": 2,
+              "name": "shelldb", "store-root": str(tmp_path / "store"),
+              "remote": rec, "db": ShellDB(),
+              "client": fake.atom_client(state),
+              "model": M.cas_register(),
+              "generator": G.clients(G.limit(4, G.cas_gen))})
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
+    starts = [h for h, c in rec.commands if "systemctl start" in c]
+    stops = [h for h, c in rec.commands if "systemctl stop" in c]
+    assert sorted(starts) == ["n1", "n2"]
+    # cycle! tears down first, then run teardown at the end: 2 per node
+    assert sorted(stops) == ["n1", "n1", "n2", "n2"]
+
+
+def test_nemesis_time_compiles_real_helpers(tmp_path):
+    """Compile the bump/strobe C helpers locally and check their argv
+    contract (without actually setting the clock)."""
+    import subprocess
+
+    from comdb2_tpu.harness import nemesis_time as NT
+
+    import os
+    s = control.Session("localhost", LocalRemote(),
+                        root=os.geteuid() == 0)
+    with control.with_session(s):
+        NT.install(install_dir=str(tmp_path))
+    for name in ("bump-time", "strobe-time"):
+        binary = tmp_path / name
+        assert binary.exists()
+        p = subprocess.run([str(binary)], capture_output=True, text=True)
+        assert p.returncode == 2
+        assert "usage" in p.stderr
+
+
+def test_heal_all_and_loop():
+    from comdb2_tpu.harness import cluster
+
+    rec = RecordingRemote()
+    test = {"nodes": ["n1"], "remote": rec}
+    cluster.heal_all(test, processes=["comdb2"])
+    cmds = [c for _, c in rec.commands]
+    assert any("iptables -F" in c for c in cmds)
+    assert any("killall -s CONT comdb2" in c for c in cmds)
+
+    runs = []
+    def make_test():
+        return {"n": len(runs)}
+    def run_fn(t):
+        runs.append(t)
+        return {"results": {"valid?": len(runs) < 3}}
+    n = cluster.test_loop(make_test, run_fn, max_runs=10)
+    assert n == 2 and len(runs) == 3
