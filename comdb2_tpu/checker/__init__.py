@@ -15,9 +15,9 @@ from .linear import analysis, Analysis
 from .checkers import (Checker, check_safe, compose, merge_valid,
                        linearizable, Linearizable, unbridled_optimism,
                        queue, set_checker, total_queue, counter)
-from . import independent, workloads
+from . import independent, workloads, wgl
 
 __all__ = ["analysis", "Analysis", "Checker", "check_safe", "compose",
            "merge_valid", "linearizable", "Linearizable",
            "unbridled_optimism", "queue", "set_checker", "total_queue",
-           "counter", "independent", "workloads"]
+           "counter", "independent", "workloads", "wgl"]

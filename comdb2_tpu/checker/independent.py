@@ -17,35 +17,9 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+from ..ops.kv import KVTuple, is_tuple, tuple_
 from ..ops.op import Op
 from .checkers import Checker, Linearizable, check_safe, merge_valid
-
-
-class KVTuple(tuple):
-    """A key/value pair distinguishable from ordinary tuple values —
-    the analog of the reference's ``clojure.lang.MapEntry``
-    (``independent.clj:20-28``)."""
-
-    __slots__ = ()
-
-    def __new__(cls, k, v):
-        return tuple.__new__(cls, (k, v))
-
-    @property
-    def key(self):
-        return self[0]
-
-    @property
-    def value(self):
-        return self[1]
-
-
-def tuple_(k, v) -> KVTuple:
-    return KVTuple(k, v)
-
-
-def is_tuple(x: Any) -> bool:
-    return isinstance(x, KVTuple)
 
 
 def wrap_keyed_history(history: Iterable[Op]) -> List[Op]:

@@ -13,7 +13,11 @@ from . import cli
 from . import nemesis
 from . import nemesis_time
 from . import cluster
+from . import faketime
+from . import killcluster
+from . import web
 from .core import run, run_case
 
 __all__ = ["generator", "client", "db", "core", "store", "fake", "cli",
-           "nemesis", "nemesis_time", "cluster", "run", "run_case"]
+           "nemesis", "nemesis_time", "cluster", "faketime",
+           "killcluster", "web", "run", "run_case"]

@@ -93,13 +93,21 @@ class CASRegister(Model):
 class CASRegisterComdb2(Model):
     """CAS register whose op values are ``(key, v)`` tuples as produced by
     ``independent/tuple`` (``knossos/model.clj:67-93``): the key is
-    ignored, the payload is the second element."""
+    ignored, the payload is the second element. Only tagged
+    :class:`~comdb2_tpu.ops.kv.KVTuple` values (or plain 2-sequences
+    from EDN histories whose second element carries the payload) are
+    unwrapped — a bare ``(expected, new)`` cas pair must NOT be."""
 
     value: Any = None
 
     def _unwrap(self, value):
-        if isinstance(value, tuple) and len(value) == 2:
-            return value[1]
+        from ..ops.kv import KVTuple
+
+        # only explicitly-tagged keyed values unwrap: a bare 2-tuple is
+        # a cas (expected, new) pair, not a key wrapper — EDN histories
+        # with [k v] vectors opt in via independent.wrap_keyed_history
+        if isinstance(value, KVTuple):
+            return value.value
         return value
 
     def step(self, f, value):

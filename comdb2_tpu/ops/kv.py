@@ -1,0 +1,32 @@
+"""Keyed op values — the ``independent/tuple`` MapEntry analog
+(``independent.clj:20-28``). Lives in ops so both the checker layer and
+the models can type-test keyed values without import cycles."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class KVTuple(tuple):
+    """A (key, value) pair distinguishable from ordinary tuple values."""
+
+    __slots__ = ()
+
+    def __new__(cls, k, v):
+        return tuple.__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+
+def tuple_(k, v) -> KVTuple:
+    return KVTuple(k, v)
+
+
+def is_tuple(x: Any) -> bool:
+    return isinstance(x, KVTuple)

@@ -1,0 +1,12 @@
+"""Workloads: the comdb2 test suite over a table-level SUT interface.
+
+- :mod:`comdb2_tpu.workloads.sqlish` — serializable connection protocol
+  + in-memory backend with chaos injection
+- :mod:`comdb2_tpu.workloads.comdb2` — cas-register, bank, sets,
+  dirty-reads, G2 workloads and their test builders
+"""
+
+from . import sqlish
+from . import comdb2
+
+__all__ = ["sqlish", "comdb2"]

@@ -1,0 +1,38 @@
+/* Thread-safe EDN history emitter.
+ *
+ * Writes the interchange format the TPU checker ingests — the same
+ * shape ctest/register.c:282-375 emits under a mutex with -j:
+ *   {:type :invoke :f :cas :value [0 3] :process 2 :time 123456}
+ * One op map per line inside a top-level vector.
+ */
+#ifndef COMDB2_TPU_EDN_HISTORY_H
+#define COMDB2_TPU_EDN_HISTORY_H
+
+#include <stdint.h>
+#include <stdio.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct edn_history edn_history;
+
+/* NULL path -> no-op emitter (drivers can run without recording) */
+edn_history *edn_open(const char *path);
+/* closes the vector and the file */
+void edn_close(edn_history *e);
+
+/* type: "invoke" | "ok" | "fail" | "info"; value strings are raw EDN
+ * fragments ("nil", "3", "[0 3]", "#{1 2}") composed by the caller */
+void edn_emit(edn_history *e, const char *type, const char *f,
+              const char *value_edn, int process, uint64_t time_us);
+
+/* helpers for composing value fragments */
+void edn_int(char *buf, size_t cap, long long v);
+void edn_nil(char *buf, size_t cap);
+void edn_pair(char *buf, size_t cap, long long a, long long b);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

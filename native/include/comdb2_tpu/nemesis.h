@@ -1,0 +1,52 @@
+/* Native nemesis — fault injection over ssh from a workload driver.
+ *
+ * Role of the reference's ctest/nemesis.{h,c} (breaknet/fixnet/
+ * signaldb/breakclocks/fixclocks/fixall), generalized: the node list is
+ * given explicitly (comma-separated) instead of scraped from cdb2
+ * cluster metadata, and the target process name is a parameter instead
+ * of hardcoded comdb2 pidfiles.
+ */
+#ifndef COMDB2_TPU_NEMESIS_H
+#define COMDB2_TPU_NEMESIS_H
+
+#include <stdint.h>
+#include <stdio.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum {
+    NEMESIS_VERBOSE = 1u << 0,
+    /* print the shell commands to the trace stream instead of running
+       them — lets tests assert on exact fault actions */
+    NEMESIS_DRYRUN = 1u << 1,
+};
+
+typedef struct nemesis nemesis;
+
+nemesis *nemesis_open(const char *nodes_csv, const char *process_name,
+                      uint32_t flags, unsigned seed);
+void nemesis_close(nemesis *n);
+
+/* where DRYRUN/VERBOSE output goes (default stderr) */
+void nemesis_set_trace(nemesis *n, FILE *f);
+
+/* partition a random half from the rest (iptables DROP at both sides) */
+void nem_breaknet(nemesis *n);
+/* flush all DROP rules everywhere */
+void nem_fixnet(nemesis *n);
+/* SIGSTOP/SIGCONT the SUT process on a random node (all=0) or all
+ * nodes (all=1) */
+void nem_signaldb(nemesis *n, int sig, int all);
+/* skew every node's clock by a random offset within ±max_skew_s */
+void nem_breakclocks(nemesis *n, int max_skew_s);
+/* re-sync clocks via ntpdate */
+void nem_fixclocks(nemesis *n);
+/* undo everything */
+void nem_fixall(nemesis *n);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

@@ -1,0 +1,59 @@
+/* SUT client ABI — the seam between native workload drivers and any
+ * system under test.
+ *
+ * The reference's drivers (ctest/register.c, ctest/insert.c) are welded
+ * to cdb2api; this framework's drivers speak a small C ABI instead so a
+ * backend can be an in-memory model (self-test), a socket bridge, or a
+ * real database client library. Outcomes are tri-state, mirroring the
+ * harness's ok / fail / info(indeterminate) op types.
+ */
+#ifndef COMDB2_TPU_SUT_H
+#define COMDB2_TPU_SUT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum {
+    SUT_OK = 0,       /* definitely applied */
+    SUT_FAIL = 1,     /* definitely not applied */
+    SUT_UNKNOWN = 2,  /* indeterminate (timeout / crash): op may have
+                         applied — becomes an :info op in the history */
+};
+
+/* backend behavior flags */
+enum {
+    SUT_F_NONE = 0,
+    /* inject random FAIL/UNKNOWN outcomes (fault tolerance testing of
+       the drivers themselves) */
+    SUT_F_FLAKY = 1u << 0,
+    /* deliberately buggy: lost updates + stale reads. Histories from a
+       buggy backend MUST be judged invalid by the checker — the
+       negative control for the whole pipeline */
+    SUT_F_BUGGY = 1u << 1,
+};
+
+typedef struct sut_handle sut_handle;
+
+sut_handle *sut_open(const char *target, uint32_t flags, unsigned seed);
+void sut_close(sut_handle *h);
+
+/* single register (the jepsen `register` table: one row, id/val):
+ * reads set *found=0 when no value was ever written */
+int sut_reg_read(sut_handle *h, int *val, int *found);
+int sut_reg_write(sut_handle *h, int val);
+/* cas applies iff current == expected; SUT_FAIL when it doesn't match */
+int sut_reg_cas(sut_handle *h, int expected, int newval);
+
+/* grow-only set (the jepsen `jepsen(id,value)` table) */
+int sut_set_add(sut_handle *h, long long val);
+/* snapshot read; caller frees *vals with free() */
+int sut_set_read(sut_handle *h, long long **vals, size_t *n);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

@@ -1,0 +1,28 @@
+/* Timing + thread-prefixed tracing for the native workload drivers.
+ * Role of the reference's ctest/testutil.{h,c} (tdprintf, timems/timeus),
+ * re-designed for the SUT-agnostic driver ABI. */
+#ifndef COMDB2_TPU_TESTUTIL_H
+#define COMDB2_TPU_TESTUTIL_H
+
+#include <stdint.h>
+#include <stdio.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* wall-clock in ms / us since the epoch */
+uint64_t ct_timems(void);
+uint64_t ct_timeus(void);
+
+/* fprintf prefixed with "[time thread-id fn:line]" — the tracing shape
+ * of testutil.c:14-48 (cnonce/snapshot-LSN fields are cdb2-specific and
+ * have no analog in the generic ABI) */
+void ct_tdprintf(FILE *f, const char *fn, int line, const char *fmt, ...);
+
+#define CT_TRACE(f, ...) ct_tdprintf((f), __func__, __LINE__, __VA_ARGS__)
+
+#ifdef __cplusplus
+}
+#endif
+#endif

@@ -1,0 +1,59 @@
+#include "comdb2_tpu/edn_history.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+
+struct edn_history {
+    FILE *f = nullptr;
+    std::mutex mu;
+};
+
+extern "C" {
+
+edn_history *edn_open(const char *path) {
+    auto *e = new edn_history();
+    if (path != nullptr) {
+        e->f = fopen(path, "w");
+        if (e->f == nullptr) {
+            delete e;
+            return nullptr;
+        }
+        fputs("[\n", e->f);
+    }
+    return e;
+}
+
+void edn_close(edn_history *e) {
+    if (e == nullptr) return;
+    if (e->f != nullptr) {
+        fputs("]\n", e->f);
+        fclose(e->f);
+    }
+    delete e;
+}
+
+void edn_emit(edn_history *e, const char *type, const char *f,
+              const char *value_edn, int process, uint64_t time_us) {
+    if (e == nullptr || e->f == nullptr) return;
+    std::lock_guard<std::mutex> g(e->mu);
+    fprintf(e->f,
+            "{:type :%s :f :%s :value %s :process %d :time %llu}\n",
+            type, f, value_edn, process, (unsigned long long)time_us);
+    fflush(e->f);
+}
+
+void edn_int(char *buf, size_t cap, long long v) {
+    snprintf(buf, cap, "%lld", v);
+}
+
+void edn_nil(char *buf, size_t cap) {
+    snprintf(buf, cap, "nil");
+}
+
+void edn_pair(char *buf, size_t cap, long long a, long long b) {
+    snprintf(buf, cap, "[%lld %lld]", a, b);
+}
+
+}  /* extern "C" */

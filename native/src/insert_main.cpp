@@ -1,0 +1,158 @@
+/* insert (set) workload driver — threads insert unique increasing
+ * values, then a final read classifies every attempt.
+ *
+ * Role of the reference's ctest/insert.c: the per-value state machine
+ * (OK/FAILED/UNKNOWN at insert time → CHECKED/RECOVERED/LOST at check
+ * time, insert.c:859-871, check() at :355-437) re-built over the
+ * generic SUT ABI, with the same exit contract: 0 iff nothing was lost
+ * and nothing unexpected appeared. Also emits an EDN history whose
+ * final :read the Python set checker (checker.clj:108-154 semantics)
+ * can re-verify offline.
+ */
+#include "comdb2_tpu/edn_history.h"
+#include "comdb2_tpu/sut.h"
+#include "comdb2_tpu/testutil.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <unistd.h>
+
+namespace {
+
+enum class St : uint8_t { OK, FAILED, UNKNOWN };
+
+struct Opts {
+    int nthreads = 5;
+    long n_inserts = 1000;      /* total across threads */
+    const char *edn_path = nullptr;
+    uint32_t sut_flags = SUT_F_NONE;
+    unsigned seed = 0;
+};
+
+void usage(const char *argv0) {
+    fprintf(stderr,
+            "Usage: %s [opts]\n"
+            "  -T n     worker threads (default 5)\n"
+            "  -i n     total inserts (default 1000)\n"
+            "  -j file  EDN history output\n"
+            "  -F       flaky SUT backend\n"
+            "  -B       buggy SUT backend (MUST be caught: exit 1)\n"
+            "  -s seed  rng seed\n",
+            argv0);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    Opts opt;
+    int c;
+    while ((c = getopt(argc, argv, "T:i:j:FBs:h")) != -1) {
+        switch (c) {
+        case 'T': opt.nthreads = atoi(optarg); break;
+        case 'i': opt.n_inserts = atol(optarg); break;
+        case 'j': opt.edn_path = optarg; break;
+        case 'F': opt.sut_flags |= SUT_F_FLAKY; break;
+        case 'B': opt.sut_flags |= SUT_F_BUGGY; break;
+        case 's': opt.seed = (unsigned)atol(optarg); break;
+        default: usage(argv[0]); return 2;
+        }
+    }
+
+    edn_history *edn = edn_open(opt.edn_path);
+    if (opt.edn_path != nullptr && edn == nullptr) {
+        fprintf(stderr, "cannot open %s\n", opt.edn_path);
+        return 2;
+    }
+
+    std::vector<St> state((size_t)opt.n_inserts, St::FAILED);
+    std::atomic<long> next{0};
+
+    auto worker = [&](int tid) {
+        sut_handle *h =
+            sut_open(nullptr, opt.sut_flags, opt.seed * 131u + (unsigned)tid);
+        char val[64];
+        int process = tid;
+        for (;;) {
+            long v = next.fetch_add(1);
+            if (v >= opt.n_inserts) break;
+            edn_int(val, sizeof val, v);
+            edn_emit(edn, "invoke", "add", val, process, ct_timeus());
+            int rc = sut_set_add(h, v);
+            if (rc == SUT_OK) {
+                state[(size_t)v] = St::OK;
+                edn_emit(edn, "ok", "add", val, process, ct_timeus());
+            } else if (rc == SUT_FAIL) {
+                state[(size_t)v] = St::FAILED;
+                edn_emit(edn, "fail", "add", val, process, ct_timeus());
+            } else {
+                state[(size_t)v] = St::UNKNOWN;
+                edn_emit(edn, "info", "add", val, process, ct_timeus());
+                process += opt.nthreads;
+            }
+        }
+        sut_close(h);
+    };
+
+    std::vector<std::thread> threads;
+    for (int i = 0; i < opt.nthreads; i++) threads.emplace_back(worker, i);
+    for (auto &t : threads) t.join();
+
+    /* final read + classification (insert.c check(), :355-437) */
+    sut_handle *h = sut_open(nullptr, SUT_F_NONE, opt.seed);
+    long long *vals = nullptr;
+    size_t n = 0;
+    /* the reader needs a process id outside every worker's retirement
+     * chain (tid + k*nthreads covers all non-negative ids) */
+    const int reader = -1;
+    edn_emit(edn, "invoke", "read", "nil", reader, ct_timeus());
+    int rc = sut_set_read(h, &vals, &n);
+    if (rc != SUT_OK) {
+        fprintf(stderr, "final read failed\n");
+        return 2;
+    }
+    std::string setbuf = "[";
+    std::vector<bool> present((size_t)opt.n_inserts, false);
+    long unexpected = 0;
+    for (size_t i = 0; i < n; i++) {
+        if (vals[i] < 0 || vals[i] >= opt.n_inserts) {
+            unexpected++;
+            continue;
+        }
+        if (present[(size_t)vals[i]]) continue;   /* dup read row */
+        present[(size_t)vals[i]] = true;
+        if (i > 0) setbuf += " ";
+        setbuf += std::to_string(vals[i]);
+    }
+    setbuf += "]";
+    free(vals);
+    edn_emit(edn, "ok", "read", setbuf.c_str(), reader, ct_timeus());
+    edn_close(edn);
+    sut_close(h);
+
+    long checked = 0, lost = 0, recovered = 0, failed = 0;
+    for (long v = 0; v < opt.n_inserts; v++) {
+        switch (state[(size_t)v]) {
+        case St::OK:
+            if (present[(size_t)v]) checked++;
+            else lost++;
+            break;
+        case St::UNKNOWN:
+            if (present[(size_t)v]) recovered++;
+            break;
+        case St::FAILED:
+            if (present[(size_t)v]) unexpected++;
+            else failed++;
+            break;
+        }
+    }
+    printf("{\"checked\": %ld, \"lost\": %ld, \"recovered\": %ld, "
+           "\"failed\": %ld, \"unexpected\": %ld}\n",
+           checked, lost, recovered, failed, unexpected);
+    return (lost == 0 && unexpected == 0) ? 0 : 1;
+}

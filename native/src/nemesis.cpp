@@ -1,0 +1,132 @@
+#include "comdb2_tpu/nemesis.h"
+#include "comdb2_tpu/testutil.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+struct nemesis {
+    std::vector<std::string> nodes;
+    std::string proc;
+    uint32_t flags;
+    std::mt19937 rng;
+    FILE *trace = stderr;
+};
+
+namespace {
+
+void run(nemesis *n, const std::string &cmd) {
+    if (n->flags & (NEMESIS_VERBOSE | NEMESIS_DRYRUN))
+        fprintf(n->trace, "nemesis: %s\n", cmd.c_str());
+    if (!(n->flags & NEMESIS_DRYRUN)) {
+        int rc = system(cmd.c_str());
+        if (rc != 0)
+            CT_TRACE(stderr, "command failed rc=%d: %s\n", rc, cmd.c_str());
+    }
+}
+
+std::string ssh(const std::string &node, const std::string &remote_cmd) {
+    return "ssh -o StrictHostKeyChecking=no -o BatchMode=yes " + node +
+           " \"" + remote_cmd + "\"";
+}
+
+}  // namespace
+
+extern "C" {
+
+nemesis *nemesis_open(const char *nodes_csv, const char *process_name,
+                      uint32_t flags, unsigned seed) {
+    if (nodes_csv == nullptr || *nodes_csv == '\0') return nullptr;
+    auto *n = new nemesis();
+    n->proc = process_name != nullptr ? process_name : "comdb2";
+    n->flags = flags;
+    n->rng.seed(seed);
+    std::string s(nodes_csv);
+    size_t pos = 0;
+    while (pos <= s.size()) {
+        size_t c = s.find(',', pos);
+        if (c == std::string::npos) c = s.size();
+        if (c > pos) n->nodes.push_back(s.substr(pos, c - pos));
+        pos = c + 1;
+    }
+    if (n->nodes.empty()) {
+        delete n;
+        return nullptr;
+    }
+    return n;
+}
+
+void nemesis_close(nemesis *n) {
+    delete n;
+}
+
+void nemesis_set_trace(nemesis *n, FILE *f) {
+    n->trace = f;
+}
+
+void nem_breaknet(nemesis *n) {
+    /* cut a random half from the rest, DROP rules on both sides of
+     * every cross-component pair (shape of nemesis.c:90-144, grudge
+     * math of jepsen's complete-grudge) */
+    std::vector<std::string> shuffled = n->nodes;
+    std::shuffle(shuffled.begin(), shuffled.end(), n->rng);
+    size_t half = shuffled.size() / 2;
+    for (size_t i = 0; i < shuffled.size(); i++) {
+        for (size_t j = 0; j < shuffled.size(); j++) {
+            bool cross = (i < half) != (j < half);
+            if (!cross || i == j) continue;
+            run(n, ssh(shuffled[i],
+                       "iptables -A INPUT -s " + shuffled[j] +
+                           " -j DROP -w"));
+        }
+    }
+}
+
+void nem_fixnet(nemesis *n) {
+    for (const auto &node : n->nodes) {
+        run(n, ssh(node, "iptables -F -w; iptables -X -w"));
+    }
+}
+
+void nem_signaldb(nemesis *n, int sig, int all) {
+    const char *name = sig == 19 ? "STOP" : sig == 18 ? "CONT" : nullptr;
+    char buf[32];
+    if (name == nullptr) {
+        snprintf(buf, sizeof buf, "%d", sig);
+        name = buf;
+    }
+    if (all) {
+        for (const auto &node : n->nodes)
+            run(n, ssh(node, "killall -s " + std::string(name) + " " +
+                                 n->proc));
+    } else {
+        const std::string &node =
+            n->nodes[n->rng() % n->nodes.size()];
+        run(n, ssh(node,
+                   "killall -s " + std::string(name) + " " + n->proc));
+    }
+}
+
+void nem_breakclocks(nemesis *n, int max_skew_s) {
+    for (const auto &node : n->nodes) {
+        long skew = (long)(n->rng() % (2 * (unsigned)max_skew_s + 1)) -
+                    max_skew_s;
+        run(n, ssh(node, "date -s @$(( $(date +%s) + " +
+                             std::to_string(skew) + " ))"));
+    }
+}
+
+void nem_fixclocks(nemesis *n) {
+    for (const auto &node : n->nodes)
+        run(n, ssh(node, "ntpdate -p 1 -b pool.ntp.org || true"));
+}
+
+void nem_fixall(nemesis *n) {
+    nem_fixnet(n);
+    nem_signaldb(n, 18 /* SIGCONT */, 1);
+}
+
+}  /* extern "C" */

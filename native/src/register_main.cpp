@@ -1,0 +1,205 @@
+/* register workload driver — N concurrent single-threaded processes
+ * doing read/write/cas against a SUT, emitting a Jepsen-format EDN
+ * history for the TPU checker.
+ *
+ * Role of the reference's ctest/register.c (5 threads, op = rand()%3,
+ * EDN via -j, mid-run nemesis events at runtime/2) with two deliberate
+ * departures: (1) the SUT is reached through the generic ABI in sut.h
+ * instead of cdb2api, and (2) an indeterminate outcome emits an :info op
+ * and retires the process id (the harness rule, jepsen/core.clj:178-200)
+ * instead of aborting the run (register.c:329-332 exits on rc -105).
+ */
+#include "comdb2_tpu/edn_history.h"
+#include "comdb2_tpu/nemesis.h"
+#include "comdb2_tpu/sut.h"
+#include "comdb2_tpu/testutil.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <unistd.h>
+
+namespace {
+
+struct Opts {
+    int nthreads = 5;
+    double runtime_s = 10.0;
+    long max_ops = -1;           /* per thread; -1 = time-bound only */
+    const char *edn_path = nullptr;
+    const char *nodes = nullptr; /* enable nemesis when set */
+    const char *proc = "comdb2";
+    uint32_t sut_flags = SUT_F_NONE;
+    uint32_t nem_flags = 0;
+    unsigned seed = 0;
+    int values = 5;
+    int events = 0;              /* bitmask: 1 partition 2 sigstop 4 clock */
+};
+
+void usage(const char *argv0) {
+    fprintf(stderr,
+            "Usage: %s [opts]\n"
+            "  -T n        worker threads (default 5)\n"
+            "  -r secs     runtime (default 10)\n"
+            "  -i n        max ops per thread\n"
+            "  -j file     EDN history output\n"
+            "  -n csv      node list; enables nemesis events\n"
+            "  -P name     SUT process name for sigstop events\n"
+            "  -G ev       add nemesis event: partition|sigstop|clock\n"
+            "  -F          flaky SUT backend (random fail/indeterminate)\n"
+            "  -B          buggy SUT backend (MUST yield invalid history)\n"
+            "  -s seed     rng seed\n"
+            "  -D          nemesis dry-run (print commands only)\n",
+            argv0);
+}
+
+struct Driver {
+    Opts opt;
+    edn_history *edn;
+    std::atomic<long> total_ops{0};
+
+    void thread_main(int tid) {
+        std::mt19937 rng(opt.seed * 7919u + (unsigned)tid + 1);
+        sut_handle *h = sut_open(nullptr, opt.sut_flags,
+                                 opt.seed * 31u + (unsigned)tid);
+        uint64_t deadline =
+            ct_timems() + (uint64_t)(opt.runtime_s * 1000);
+        int process = tid;
+        long ops = 0;
+        char val[64];
+        while (ct_timems() < deadline &&
+               (opt.max_ops < 0 || ops < opt.max_ops)) {
+            int op = (int)(rng() % 3);
+            int newval = (int)(rng() % (unsigned)opt.values);
+            int curval = (int)(rng() % (unsigned)opt.values);
+            int rc;
+            if (op == 0) {                               /* read */
+                edn_nil(val, sizeof val);
+                edn_emit(edn, "invoke", "read", val, process, ct_timeus());
+                int got = 0, found = 0;
+                rc = sut_reg_read(h, &got, &found);
+                if (rc == SUT_OK) {
+                    if (found) edn_int(val, sizeof val, got);
+                    else edn_nil(val, sizeof val);
+                    edn_emit(edn, "ok", "read", val, process, ct_timeus());
+                } else if (rc == SUT_FAIL) {
+                    edn_emit(edn, "fail", "read", val, process,
+                             ct_timeus());
+                } else {
+                    edn_emit(edn, "info", "read", val, process,
+                             ct_timeus());
+                    process += opt.nthreads;   /* retire the process id */
+                }
+            } else if (op == 1) {                        /* write */
+                edn_int(val, sizeof val, newval);
+                edn_emit(edn, "invoke", "write", val, process,
+                         ct_timeus());
+                rc = sut_reg_write(h, newval);
+                if (rc == SUT_OK) {
+                    edn_emit(edn, "ok", "write", val, process, ct_timeus());
+                } else if (rc == SUT_FAIL) {
+                    edn_emit(edn, "fail", "write", val, process,
+                             ct_timeus());
+                } else {
+                    edn_emit(edn, "info", "write", val, process,
+                             ct_timeus());
+                    process += opt.nthreads;
+                }
+            } else {                                     /* cas */
+                edn_pair(val, sizeof val, curval, newval);
+                edn_emit(edn, "invoke", "cas", val, process, ct_timeus());
+                rc = sut_reg_cas(h, curval, newval);
+                if (rc == SUT_OK) {
+                    edn_emit(edn, "ok", "cas", val, process, ct_timeus());
+                } else if (rc == SUT_FAIL) {
+                    edn_emit(edn, "fail", "cas", val, process,
+                             ct_timeus());
+                } else {
+                    edn_emit(edn, "info", "cas", val, process,
+                             ct_timeus());
+                    process += opt.nthreads;
+                }
+            }
+            ops++;
+        }
+        total_ops += ops;
+        sut_close(h);
+    }
+};
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    Opts opt;
+    int c;
+    while ((c = getopt(argc, argv, "T:r:i:j:n:P:G:FBs:Dh")) != -1) {
+        switch (c) {
+        case 'T': opt.nthreads = atoi(optarg); break;
+        case 'r': opt.runtime_s = atof(optarg); break;
+        case 'i': opt.max_ops = atol(optarg); break;
+        case 'j': opt.edn_path = optarg; break;
+        case 'n': opt.nodes = optarg; break;
+        case 'P': opt.proc = optarg; break;
+        case 'G':
+            if (strcmp(optarg, "partition") == 0) opt.events |= 1;
+            else if (strcmp(optarg, "sigstop") == 0) opt.events |= 2;
+            else if (strcmp(optarg, "clock") == 0) opt.events |= 4;
+            else { usage(argv[0]); return 2; }
+            break;
+        case 'F': opt.sut_flags |= SUT_F_FLAKY; break;
+        case 'B': opt.sut_flags |= SUT_F_BUGGY; break;
+        case 's': opt.seed = (unsigned)atol(optarg); break;
+        case 'D': opt.nem_flags |= NEMESIS_DRYRUN; break;
+        default: usage(argv[0]); return 2;
+        }
+    }
+
+    Driver d;
+    d.opt = opt;
+    d.edn = edn_open(opt.edn_path);
+    if (opt.edn_path != nullptr && d.edn == nullptr) {
+        fprintf(stderr, "cannot open %s\n", opt.edn_path);
+        return 2;
+    }
+
+    nemesis *nem = nullptr;
+    if (opt.nodes != nullptr && opt.events != 0) {
+        nem = nemesis_open(opt.nodes, opt.proc, opt.nem_flags, opt.seed);
+        if (nem == nullptr) {
+            fprintf(stderr, "bad node list\n");
+            return 2;
+        }
+        nem_fixall(nem);
+    }
+
+    std::vector<std::thread> threads;
+    threads.reserve(opt.nthreads);
+    for (int i = 0; i < opt.nthreads; i++)
+        threads.emplace_back([&d, i] { d.thread_main(i); });
+
+    if (nem != nullptr) {
+        /* fire faults at runtime/2, heal before the end
+         * (register.c:575-598) */
+        usleep((useconds_t)(opt.runtime_s * 1e6 / 2));
+        if (opt.events & 1) nem_breaknet(nem);
+        if (opt.events & 2) nem_signaldb(nem, 19, 0);
+        if (opt.events & 4) nem_breakclocks(nem, 60);
+        usleep((useconds_t)(opt.runtime_s * 1e6 / 4));
+        if (opt.events & 1) nem_fixnet(nem);
+        if (opt.events & 2) nem_signaldb(nem, 18, 1);
+        if (opt.events & 4) nem_fixclocks(nem);
+    }
+
+    for (auto &t : threads) t.join();
+    edn_close(d.edn);
+    if (nem != nullptr) {
+        nem_fixall(nem);
+        nemesis_close(nem);
+    }
+    fprintf(stderr, "register driver: %ld ops across %d threads\n",
+            d.total_ops.load(), opt.nthreads);
+    return 0;
+}

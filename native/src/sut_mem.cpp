@@ -1,0 +1,143 @@
+/* In-memory SUT backend: a genuinely linearizable register + grow-only
+ * set behind one mutex, with optional injected flakiness and an optional
+ * deliberate consistency bug (negative control for the checker).
+ *
+ * This fills the role of the reference's atom-backed fake SUT
+ * (jepsen/tests.clj:27-56) for the *native* drivers: it validates the
+ * driver ↔ EDN ↔ checker pipeline without a cluster.
+ */
+#include "comdb2_tpu/sut.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace {
+
+/* process-wide shared state: every handle in this process sees the same
+ * register/set, like every client connecting to one database */
+struct Shared {
+    std::mutex mu;
+    int reg_val = 0;
+    bool reg_written = false;
+    std::vector<long long> set_vals;
+    /* buggy mode: writes are dropped with probability 1/4 *after*
+     * reporting OK (lost update), and reads return a stale snapshot
+     * with probability 1/4 */
+    int stale_val = 0;
+    bool stale_written = false;
+};
+
+Shared &shared() {
+    static Shared s;
+    return s;
+}
+
+}  // namespace
+
+struct sut_handle {
+    uint32_t flags;
+    std::mt19937 rng;
+
+    explicit sut_handle(uint32_t fl, unsigned seed) : flags(fl), rng(seed) {}
+
+    /* pre-commit fault: FAIL means the op definitely did not run */
+    bool flaky_fail() {
+        return (flags & SUT_F_FLAKY) && rng() % 8 == 0;
+    }
+    /* post-commit fault: the op ran but the client never heard back */
+    bool flaky_unknown() {
+        return (flags & SUT_F_FLAKY) && rng() % 8 == 0;
+    }
+    bool bug_roll() {
+        return (flags & SUT_F_BUGGY) && rng() % 4 == 0;
+    }
+};
+
+extern "C" {
+
+sut_handle *sut_open(const char *, uint32_t flags, unsigned seed) {
+    return new sut_handle(flags, seed);
+}
+
+void sut_close(sut_handle *h) {
+    delete h;
+}
+
+int sut_reg_read(sut_handle *h, int *val, int *found) {
+    if (h->flaky_fail()) return SUT_FAIL;
+    Shared &s = shared();
+    std::lock_guard<std::mutex> g(s.mu);
+    if (h->bug_roll() && s.stale_written) {
+        *val = s.stale_val;        /* stale read: consistency bug */
+        *found = 1;
+    } else {
+        *val = s.reg_val;
+        *found = s.reg_written ? 1 : 0;
+    }
+    return SUT_OK;
+}
+
+int sut_reg_write(sut_handle *h, int val) {
+    if (h->flaky_fail()) return SUT_FAIL;
+    Shared &s = shared();
+    {
+        std::lock_guard<std::mutex> g(s.mu);
+        s.stale_val = s.reg_val;
+        s.stale_written = s.reg_written;
+        if (!h->bug_roll()) {      /* buggy mode may drop the write */
+            s.reg_val = val;
+            s.reg_written = true;
+        }
+    }
+    if (h->flaky_unknown()) return SUT_UNKNOWN;
+    return SUT_OK;
+}
+
+int sut_reg_cas(sut_handle *h, int expected, int newval) {
+    if (h->flaky_fail()) return SUT_FAIL;
+    Shared &s = shared();
+    int applied;
+    {
+        std::lock_guard<std::mutex> g(s.mu);
+        if (s.reg_written && s.reg_val == expected) {
+            s.stale_val = s.reg_val;
+            s.stale_written = s.reg_written;
+            if (!h->bug_roll()) {
+                s.reg_val = newval;
+            }
+            applied = 1;
+        } else {
+            applied = 0;
+        }
+    }
+    if (applied && h->flaky_unknown()) return SUT_UNKNOWN;
+    return applied ? SUT_OK : SUT_FAIL;
+}
+
+int sut_set_add(sut_handle *h, long long val) {
+    if (h->flaky_fail()) return SUT_FAIL;
+    Shared &s = shared();
+    {
+        std::lock_guard<std::mutex> g(s.mu);
+        if (!h->bug_roll()) {      /* buggy mode loses inserts */
+            s.set_vals.push_back(val);
+        }
+    }
+    if (h->flaky_unknown()) return SUT_UNKNOWN;
+    return SUT_OK;
+}
+
+int sut_set_read(sut_handle *h, long long **vals, size_t *n) {
+    if (h->flaky_fail()) return SUT_FAIL;
+    Shared &s = shared();
+    std::lock_guard<std::mutex> g(s.mu);
+    *n = s.set_vals.size();
+    *vals = static_cast<long long *>(malloc(sizeof(long long) * (*n + 1)));
+    memcpy(*vals, s.set_vals.data(), sizeof(long long) * *n);
+    return SUT_OK;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,42 @@
+#include "comdb2_tpu/testutil.h"
+
+#include <cstdarg>
+#include <ctime>
+
+#include <sys/time.h>
+#include <pthread.h>
+
+extern "C" {
+
+uint64_t ct_timems(void) {
+    struct timeval tv;
+    gettimeofday(&tv, nullptr);
+    return (uint64_t)tv.tv_sec * 1000ull + tv.tv_usec / 1000;
+}
+
+uint64_t ct_timeus(void) {
+    struct timeval tv;
+    gettimeofday(&tv, nullptr);
+    return (uint64_t)tv.tv_sec * 1000000ull + tv.tv_usec;
+}
+
+void ct_tdprintf(FILE *f, const char *fn, int line, const char *fmt, ...) {
+    char prefix[128];
+    struct timeval tv;
+    gettimeofday(&tv, nullptr);
+    struct tm tm;
+    localtime_r(&tv.tv_sec, &tm);
+    snprintf(prefix, sizeof prefix,
+             "[%02d:%02d:%02d.%03d thd %#lx %s:%d] ", tm.tm_hour,
+             tm.tm_min, tm.tm_sec, (int)(tv.tv_usec / 1000),
+             (unsigned long)pthread_self(), fn, line);
+    va_list ap;
+    va_start(ap, fmt);
+    flockfile(f);
+    fputs(prefix, f);
+    vfprintf(f, fmt, ap);
+    funlockfile(f);
+    va_end(ap);
+}
+
+}  /* extern "C" */

@@ -2,14 +2,35 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-runs the
-multi-chip path; `bench.py` runs on the real chip). Env vars must be set
-before jax is imported anywhere.
+multi-chip path; `bench.py` runs on the real chip).
+
+The ambient environment may attach JAX to a real TPU through a tunnel
+(an interpreter-startup hook can pre-import jax and register the plugin
+BEFORE this file runs, so setting JAX_PLATFORMS here is too late).
+``jax.config.update`` works after import as long as no backend has been
+initialized, so we force the CPU platform through the config API and
+verify we actually got it.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# env vars still matter for subprocesses and not-yet-imported jax
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# persistent compile cache: the frontier-search programs are expensive to
+# compile and shape-stable across runs
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/jax-cache-comdb2tpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", (
+    f"tests must run on the CPU mesh, got {jax.default_backend()!r} — "
+    "a backend was initialized before conftest could force the platform")
+assert len(jax.devices()) == 8, jax.devices()

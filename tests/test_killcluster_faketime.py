@@ -1,0 +1,88 @@
+"""killcluster diff-oracle + faketime wrapper tests."""
+
+import os
+
+from comdb2_tpu import control
+from comdb2_tpu.control.remote import LocalRemote, RecordingRemote
+from comdb2_tpu.harness import faketime, killcluster
+from comdb2_tpu.workloads.sqlish import MemDB
+
+
+def test_oracle_shape():
+    lines = list(killcluster.oracle(3))
+    assert lines == ["[set transaction serializable] rc 0",
+                     "[begin] rc 0", "(a=0)", "(a=1)", "(a=2)",
+                     "[commit] rc 0"]
+
+
+def test_killcluster_clean_run_matches_oracle():
+    db = MemDB()
+    r = killcluster.run(
+        {}, lambda: killcluster.scripted_workload(db.connect(), 500),
+        killcluster.oracle(500))
+    assert r["valid?"] is True, r["diff"]
+
+
+def test_killcluster_disruption_with_retries_still_matches():
+    """Chaos aborts force retries mid-transaction; the committed
+    transcript must still equal the oracle exactly."""
+    db = MemDB(chaos_fail=0.3, seed=3)
+    disrupted = []
+    r = killcluster.run(
+        {}, lambda: killcluster.scripted_workload(db.connect(), 300),
+        killcluster.oracle(300),
+        disrupt=lambda: disrupted.append(True),
+        disrupt_after_s=0.0)
+    assert r["valid?"] is True, r["diff"]
+
+
+def test_killcluster_detects_lost_rows():
+    db = MemDB()
+
+    def lossy_workload():
+        yield "[set transaction serializable] rc 0"
+        yield "[begin] rc 0"
+        conn = db.connect()
+        with conn.transaction() as t:
+            for i in range(100):
+                if i != 50:               # row 50 silently lost
+                    t.insert("killcluster", {"a": i})
+        for row in sorted(r["a"] for r in conn.select("killcluster")):
+            yield f"(a={row})"
+        yield "[commit] rc 0"
+
+    r = killcluster.run({}, lossy_workload, killcluster.oracle(100))
+    assert r["valid?"] is False
+    assert r["diff"][0]["expected"] == "(a=50)"
+
+
+def test_kill_restart_all_commands():
+    rec = RecordingRemote()
+    test = {"nodes": ["n1", "n2"], "remote": rec}
+    killcluster.kill_restart_all(test, "mydb",
+                                 restart_cmd="systemctl start mydb",
+                                 stagger_s=0)
+    cmds = [c for _, c in rec.commands]
+    assert any("pkill -KILL -f mydb" in c for c in cmds)
+    assert any("systemctl start mydb" in c for c in cmds)
+
+
+def test_faketime_script_and_wrap(tmp_path):
+    s = faketime.script("/usr/bin/myapp", -30, 1.5)
+    assert 'faketime -m -f "-30s x1.5" /usr/bin/myapp "$@"' in s
+
+    target = tmp_path / "app"
+    target.write_text("#!/bin/bash\necho real\n")
+    target.chmod(0o755)
+    sess = control.Session("localhost", LocalRemote(),
+                           root=os.geteuid() == 0)
+    with control.with_session(sess):
+        faketime.wrap(str(target), 10, 2.0)
+        assert (tmp_path / "app.no-faketime").exists()
+        body = target.read_text()
+        assert "faketime" in body and "app.no-faketime" in body
+        # idempotent: wrapping again keeps the original
+        faketime.wrap(str(target), 10, 2.0)
+        assert "echo real" in (tmp_path / "app.no-faketime").read_text()
+        faketime.unwrap(str(target))
+        assert target.read_text().endswith("echo real\n")

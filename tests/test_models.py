@@ -36,12 +36,17 @@ def test_cas_register():
 
 
 def test_cas_register_comdb2_tuple_values():
+    from comdb2_tpu.ops.kv import tuple_
+
     m = cas_register_comdb2(None)
-    m = step(m, "write", (7, 1))        # key 7, value 1
+    m = step(m, "write", tuple_(7, 1))        # key 7, value 1
     assert m.value == 1
-    assert step(m, "read", (7, 1)) == m
-    assert step(m, "cas", (7, (1, 2))).value == 2
-    assert step(m, "cas", (7, (3, 2))) is None
+    assert step(m, "read", tuple_(7, 1)) == m
+    assert step(m, "cas", tuple_(7, (1, 2))).value == 2
+    assert step(m, "cas", tuple_(7, (3, 2))) is None
+    # bare 2-tuples are cas pairs, NOT key wrappers — must not unwrap
+    m2 = cas_register_comdb2(1)
+    assert step(m2, "cas", (1, 5)).value == 5
 
 
 def test_mutex():

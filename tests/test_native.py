@@ -1,0 +1,204 @@
+"""Native driver integration: build the C++ components, run the
+register/insert workloads against the in-memory SUT, and verify the
+emitted EDN histories with the Python/TPU checker — the full offline
+pipeline (SURVEY §3.6)."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "native")
+BUILD = os.path.join(NATIVE, "build")
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    if not os.path.exists(os.path.join(BUILD, "ct_register")):
+        subprocess.run(["cmake", "-S", NATIVE, "-B", BUILD],
+                       check=True, capture_output=True)
+        subprocess.run(["cmake", "--build", BUILD], check=True,
+                       capture_output=True)
+    return BUILD
+
+
+def _run(args, **kw):
+    return subprocess.run(args, capture_output=True, text=True, **kw)
+
+
+def test_register_driver_emits_valid_history(native_build, tmp_path):
+    out = tmp_path / "reg.edn"
+    p = _run([os.path.join(native_build, "ct_register"),
+              "-T", "5", "-i", "80", "-r", "30", "-j", str(out),
+              "-s", "42"])
+    assert p.returncode == 0, p.stderr
+
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.history import parse_history
+
+    h = parse_history(out.read_text())
+    assert len(h) == 800
+    a = analysis(cas_register(), h)
+    assert a.valid is True
+
+
+def test_register_driver_flaky_history_checks_out(native_build, tmp_path):
+    """Flaky outcomes (fail + indeterminate info ops with process
+    retirement) must still produce a linearizable history."""
+    out = tmp_path / "regf.edn"
+    p = _run([os.path.join(native_build, "ct_register"),
+              "-T", "4", "-i", "60", "-r", "30", "-F", "-j", str(out),
+              "-s", "3"])
+    assert p.returncode == 0, p.stderr
+
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.history import parse_history
+
+    h = parse_history(out.read_text())
+    assert any(op.type == "info" for op in h)
+    a = analysis(cas_register(), h)
+    assert a.valid is True
+
+
+def test_register_driver_buggy_history_flagged_invalid(native_build,
+                                                       tmp_path):
+    """The negative control: a backend with lost updates/stale reads
+    must produce a history the checker rejects."""
+    out = tmp_path / "regb.edn"
+    p = _run([os.path.join(native_build, "ct_register"),
+              "-T", "5", "-i", "120", "-r", "30", "-B", "-j", str(out),
+              "-s", "11"])
+    assert p.returncode == 0, p.stderr
+
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.history import parse_history
+
+    h = parse_history(out.read_text())
+    a = analysis(cas_register(), h)
+    assert a.valid is False
+
+
+def test_insert_driver_classification(native_build, tmp_path):
+    out = tmp_path / "ins.edn"
+    p = _run([os.path.join(native_build, "ct_insert"),
+              "-T", "5", "-i", "400", "-j", str(out), "-s", "7"])
+    assert p.returncode == 0, p.stderr
+    summary = json.loads(p.stdout)
+    assert summary["checked"] == 400
+    assert summary["lost"] == 0
+
+    # re-verify the emitted history with the Python set checker
+    from comdb2_tpu.checker.checkers import set_checker
+    from comdb2_tpu.ops.history import parse_history
+
+    h = parse_history(out.read_text())
+    r = set_checker.check({}, None, h)
+    assert r["valid?"] is True
+
+
+def test_insert_driver_buggy_detected_by_both(native_build, tmp_path):
+    out = tmp_path / "insb.edn"
+    p = _run([os.path.join(native_build, "ct_insert"),
+              "-T", "5", "-i", "400", "-B", "-j", str(out), "-s", "7"])
+    assert p.returncode == 1                      # driver self-check
+    summary = json.loads(p.stdout)
+    assert summary["lost"] > 0
+
+    from comdb2_tpu.checker.checkers import set_checker
+    from comdb2_tpu.ops.history import parse_history
+
+    h = parse_history(out.read_text())
+    r = set_checker.check({}, None, h)            # python checker agrees
+    assert r["valid?"] is False
+    assert r["lost"] != "#{}"
+
+
+def test_insert_flaky_recovered(native_build, tmp_path):
+    out = tmp_path / "insf.edn"
+    p = _run([os.path.join(native_build, "ct_insert"),
+              "-T", "5", "-i", "400", "-F", "-j", str(out), "-s", "9"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    summary = json.loads(p.stdout)
+    assert summary["recovered"] > 0
+
+    from comdb2_tpu.checker.checkers import set_checker
+    from comdb2_tpu.ops.history import parse_history
+
+    h = parse_history(out.read_text())
+    r = set_checker.check({}, None, h)
+    assert r["valid?"] is True
+    assert r["recovered"] != "#{}"
+
+
+def test_nemesis_dryrun_commands(native_build, tmp_path):
+    """Partition events in dry-run mode print the iptables/ssh plan."""
+    out = tmp_path / "nem.edn"
+    p = _run([os.path.join(native_build, "ct_register"),
+              "-T", "2", "-i", "10", "-r", "1", "-j", str(out),
+              "-n", "m1,m2,m3,m4,m5", "-G", "partition", "-G", "sigstop",
+              "-D", "-s", "5"])
+    assert p.returncode == 0, p.stderr
+    assert "iptables -A INPUT -s" in p.stderr
+    assert "-j DROP" in p.stderr
+    assert "killall -s STOP" in p.stderr
+    assert "killall -s CONT" in p.stderr
+    # heal commands flush rules on every node
+    assert p.stderr.count("iptables -F") >= 5
+
+
+def test_filetest_cli(native_build, tmp_path):
+    out = tmp_path / "ft.edn"
+    _run([os.path.join(native_build, "ct_register"),
+          "-T", "3", "-i", "40", "-r", "30", "-j", str(out), "-s", "1"])
+    from comdb2_tpu import filetest
+    assert filetest.main([str(out)]) == 0
+    assert filetest.main([str(out), "--backend", "host"]) == 0
+
+    bad = tmp_path / "ftb.edn"
+    _run([os.path.join(native_build, "ct_register"),
+          "-T", "5", "-i", "120", "-r", "30", "-B", "-j", str(bad),
+          "-s", "11"])
+    assert filetest.main([str(bad)]) == 1
+
+
+def test_insert_flaky_history_is_process_well_formed(native_build,
+                                                     tmp_path):
+    """Retired process ids and the final reader id must never collide —
+    history.complete() enforces the single-threaded process rule."""
+    out = tmp_path / "insf2.edn"
+    _run([os.path.join(native_build, "ct_insert"),
+          "-T", "5", "-i", "400", "-F", "-j", str(out), "-s", "9"])
+
+    from comdb2_tpu.ops.history import complete, parse_history
+
+    h = parse_history(out.read_text())
+    complete(h)     # raises if any process id is reused while pending
+
+
+def test_filetest_keyed_histories(tmp_path):
+    """EDN [k v] values re-tag as keyed tuples for the comdb2 model."""
+    edn = """
+{:type :invoke :f :write :value [7 3] :process 0 :time 1}
+{:type :ok :f :write :value [7 3] :process 0 :time 2}
+{:type :invoke :f :cas :value [7 [3 4]] :process 1 :time 3}
+{:type :ok :f :cas :value [7 [3 4]] :process 1 :time 4}
+{:type :invoke :f :read :value [7 4] :process 0 :time 5}
+{:type :ok :f :read :value [7 4] :process 0 :time 6}
+"""
+    p = tmp_path / "keyed.edn"
+    p.write_text(edn)
+    from comdb2_tpu import filetest
+    assert filetest.main([str(p), "--model", "cas-register-comdb2"]) == 0
+
+
+def test_filetest_set_checker(native_build, tmp_path):
+    out = tmp_path / "fts.edn"
+    _run([os.path.join(native_build, "ct_insert"),
+          "-T", "3", "-i", "200", "-j", str(out), "-s", "2"])
+    from comdb2_tpu import filetest
+    assert filetest.main([str(out), "--checker", "set"]) == 0

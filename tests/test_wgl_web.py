@@ -1,0 +1,110 @@
+"""Tests for the WGL world-search engine and the web store browser."""
+
+import random
+import urllib.error
+import urllib.request
+
+from comdb2_tpu.checker import wgl
+from comdb2_tpu.models import model as M
+from comdb2_tpu.ops.op import invoke, ok, info
+from comdb2_tpu.ops.synth import register_history, mutate
+
+
+def test_wgl_valid_simple():
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", 1), ok(1, "read", 1)]
+    r = wgl.analysis(M.register(), h)
+    assert r["valid?"] is True
+
+
+def test_wgl_invalid_simple():
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", None), ok(1, "read", 2)]
+    r = wgl.analysis(M.register(), h)
+    assert r["valid?"] is False
+    assert r["deepest-index"] < 4
+
+
+def test_wgl_concurrent_reorder():
+    # two concurrent writes; read sees the first-invoked one — only
+    # valid if the search reorders linearization points
+    h = [invoke(0, "write", 1),
+         invoke(1, "write", 2),
+         ok(1, "write", 2),
+         ok(0, "write", 1),
+         invoke(2, "read", 2), ok(2, "read", 2)]
+    r = wgl.analysis(M.cas_register(), h)
+    assert r["valid?"] is True
+
+
+def test_wgl_pending_info_ops():
+    # an indeterminate write may or may not have applied
+    h = [invoke(0, "write", 1), info(0, "write", 1),
+         invoke(1, "read", 1), ok(1, "read", 1)]
+    assert wgl.analysis(M.register(), h)["valid?"] is True
+    h2 = [invoke(0, "write", 1), info(0, "write", 1),
+          invoke(1, "read", None), ok(1, "read", 5)]
+    assert wgl.analysis(M.register(), h2)["valid?"] is False
+
+
+def test_wgl_agrees_with_linear_engine():
+    from comdb2_tpu.checker import linear
+
+    rng = random.Random(13)
+    for trial in range(25):
+        h = register_history(rng, n_procs=3, n_events=30, p_info=0.1)
+        if trial % 2:
+            h = mutate(rng, h)
+        expected = linear.analysis(M.cas_register(), h,
+                                   backend="host").valid
+        got = wgl.analysis(M.cas_register(), h)["valid?"]
+        assert got == expected, f"trial {trial}: wgl={got} linear={expected}"
+
+
+def test_wgl_overflow_unknown():
+    rng = random.Random(5)
+    h = register_history(rng, n_procs=4, n_events=200, p_info=0.0)
+    r = wgl.analysis(M.cas_register(), h, max_worlds=10)
+    assert r["valid?"] in (True, "unknown")   # tiny budget may still win
+
+
+# --- web --------------------------------------------------------------------
+
+def test_web_store_browser(tmp_path):
+    from comdb2_tpu.harness import core, fake, web
+    from comdb2_tpu.harness import generator as G
+    from comdb2_tpu.models import model as MM
+
+    state = fake.Atom()
+    t = fake.noop_test()
+    t.update({"nodes": [], "concurrency": 3, "name": "webtest",
+              "store-root": str(tmp_path / "store"),
+              "db": fake.atom_db(state), "client": fake.atom_client(state),
+              "model": MM.cas_register(),
+              "generator": G.clients(G.limit(10, G.cas_gen))})
+    res = core.run(t)
+
+    srv, port = web.serve(store_root=str(tmp_path / "store"), port=0,
+                          block=False)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        idx = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "webtest" in idx and "True" in idx
+        st = res["start-time"]
+        listing = urllib.request.urlopen(
+            f"{base}/files/webtest/{st}/").read().decode()
+        assert "history.edn" in listing and "results.edn" in listing
+        hist = urllib.request.urlopen(
+            f"{base}/files/webtest/{st}/history.edn").read().decode()
+        assert ":invoke" in hist
+        z = urllib.request.urlopen(f"{base}/zip/webtest/{st}").read()
+        assert z[:2] == b"PK"
+        # traversal rejected
+        try:
+            urllib.request.urlopen(f"{base}/files/../../etc/passwd")
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code in (403, 404)
+        assert raised
+    finally:
+        srv.shutdown()

@@ -1,0 +1,176 @@
+"""Workload tests: the comdb2 suite against the in-memory serializable
+backend — and negative controls with chaos/bugs injected."""
+
+import pytest
+
+from comdb2_tpu.harness import core
+from comdb2_tpu.workloads import comdb2 as W
+from comdb2_tpu.workloads.sqlish import Indeterminate, MemDB, Rollback
+
+
+def _small(test, tmp_path):
+    test["store-root"] = str(tmp_path / "store")
+    test["nodes"] = []
+    return test
+
+
+def test_memdb_serializable_txns():
+    db = MemDB()
+    c = db.connect()
+    c.insert("t", {"id": 1, "v": 10})
+    assert c.select("t", lambda r: r["id"] == 1)[0]["v"] == 10
+    assert c.update("t", {"v": 11}, lambda r: r["id"] == 1) == 1
+    assert c.update("t", {"v": 9}, lambda r: r["id"] == 99) == 0
+    assert c.delete("t") == 1
+    assert c.select("t") == []
+
+
+def test_memdb_rollback_discards_buffered_writes():
+    db = MemDB()
+    c = db.connect()
+    with pytest.raises(RuntimeError):
+        with c.transaction() as t:
+            t.insert("t", {"id": 1})
+            raise RuntimeError("abort")
+    assert c.select("t") == []
+
+
+def test_memdb_chaos_outcomes():
+    db = MemDB(chaos_fail=1.0)
+    c = db.connect()
+    with pytest.raises(Rollback):
+        c.insert("t", {"id": 1})
+    db2 = MemDB(chaos_unknown=1.0, seed=4)
+    c2 = db2.connect()
+    applied = 0
+    for i in range(20):
+        with pytest.raises(Indeterminate):
+            c2.insert("t", {"id": i})
+    applied = len(c2.db.tables.get("t", []))
+    assert 0 < applied < 20      # some committed, some didn't
+
+
+def test_register_workload_valid(tmp_path):
+    t = _small(W.register_tester(time_limit=1.5), tmp_path)
+    t["concurrency"] = 6
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+    lin = result["results"]["linearizable"]
+    assert lin["valid?"] is True
+    assert len(result["history"]) > 20
+
+
+def test_register_workload_with_chaos_still_valid(tmp_path):
+    db = MemDB(chaos_fail=0.1, chaos_unknown=0.05, seed=1)
+    t = _small(W.register_tester(connect=db.connect, time_limit=1.5),
+               tmp_path)
+    t["concurrency"] = 6
+    result = core.run(t)
+    # fails and indeterminates are normal; the history must stay
+    # linearizable because MemDB itself is correct
+    assert result["results"]["valid?"] is True, result["results"]
+    assert any(op.type == "info" for op in result["history"])
+
+
+def test_bank_workload(tmp_path):
+    t = _small(W.bank_test(time_limit=1.5, n=4), tmp_path)
+    t["concurrency"] = 6
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+    reads = [op for op in result["history"]
+             if op.type == "ok" and op.f == "read" and op.value]
+    assert reads
+    assert all(sum(op.value) == 40 for op in reads)
+
+
+def test_sets_workload(tmp_path):
+    t = _small(W.sets_test(adds=40), tmp_path)
+    t["concurrency"] = 5
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+    assert result["results"]["ok-frac"] == 1.0
+
+
+def test_sets_workload_lossy_backend_detected(tmp_path):
+    from comdb2_tpu.workloads.sqlish import MemConn
+
+    db = MemDB()
+    db.counter = 0
+
+    class LossyConn(MemConn):
+        """Acks every 5th write txn but silently discards its buffered
+        writes at commit — data loss the checker must catch."""
+
+        def transaction(self):
+            ctx = super().transaction()
+            conn_db = self.db
+
+            class MaybeDropCtx:
+                def __enter__(s):
+                    s.t = ctx.__enter__()
+                    return s.t
+
+                def __exit__(s, *a):
+                    if a[0] is None and s.t.writes:
+                        conn_db.counter += 1
+                        if conn_db.counter % 5 == 0:
+                            s.t.writes.clear()    # lost update
+                    return ctx.__exit__(*a)
+            return MaybeDropCtx()
+
+    t = _small(W.sets_test(connect=lambda: LossyConn(db), adds=40),
+               tmp_path)
+    t["concurrency"] = 5
+    result = core.run(t)
+    assert result["results"]["valid?"] is False
+    assert result["results"]["lost"] != "#{}"
+
+
+def test_dirty_reads_workload(tmp_path):
+    t = _small(W.dirty_reads_tester(time_limit=1.0, n=3), tmp_path)
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_g2_workload(tmp_path):
+    t = _small(W.g2_test(ops=60), tmp_path)
+    t["concurrency"] = 6
+    result = core.run(t)
+    # serializable backend: at most one insert per key ever commits
+    assert result["results"]["valid?"] is True, result["results"]
+    assert result["results"]["key-count"] >= 1
+
+
+def test_g2_broken_backend_detected(tmp_path):
+    """A backend whose predicate reads miss concurrent inserts lets both
+    G2 inserts commit — the checker must flag it."""
+    from comdb2_tpu.harness import client as client_ns
+    from comdb2_tpu.checker.independent import KVTuple
+
+    class BrokenG2Client(client_ns.Client):
+        def __init__(self):
+            self.committed = {}
+
+        def setup(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            k = op["value"][0]
+            # no predicate check at all: every insert succeeds
+            self.committed.setdefault(k, 0)
+            self.committed[k] += 1
+            return {**op, "type": "ok"}
+
+    t = _small(W.g2_test(ops=30), tmp_path)
+    t["client"] = BrokenG2Client()
+    t["concurrency"] = 6
+    result = core.run(t)
+    assert result["results"]["valid?"] is False
+    assert result["results"]["illegal-count"] >= 1
+
+
+def test_register_nemesis_builder_shape():
+    t = W.register_tester_nemesis(time_limit=1.0)
+    assert t["name"] == "register-nemesis"
+    from comdb2_tpu.harness import nemesis as N
+    assert isinstance(t["nemesis"], N.Partitioner)
