@@ -39,11 +39,14 @@ def main() -> None:
     n_ops = sum(1 for op in history if op.type == "invoke")
     mm = make_memo(cas_register(), packed)
     succ = LJ.pad_succ(mm.succ, 64, 64)
-    stream = LJ.make_stream(packed)
+    segs = LJ.make_segments(packed)
     F, P = 128, 8
 
     def run():
-        status, fail_at, n = LJ.check_device(succ, *stream, F=F, P=P)
+        status, fail_seg, n = LJ.check_device_seg(
+            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+            F=F, P=P,
+            n_states=mm.n_states, n_transitions=mm.n_transitions)
         jax.block_until_ready(status)
         return int(status)
 

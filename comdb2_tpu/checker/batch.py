@@ -33,6 +33,7 @@ class PackedBatch:
     proc: np.ndarray   # int32[N, n_pad]
     tr: np.ndarray     # int32[N, n_pad] — ids into the shared table
     P: int             # max process count (slot width)
+    remaps: List[np.ndarray] = None  # per-history local→union trans ids
 
     def __len__(self) -> int:
         return len(self.packeds)
@@ -82,22 +83,99 @@ def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
         trs.append(tr)
     return PackedBatch(packeds=packeds, memo=mm,
                        kind=np.stack(kinds), proc=np.stack(procs),
-                       tr=np.stack(trs), P=P)
+                       tr=np.stack(trs), P=P, remaps=remaps)
+
+
+@dataclass
+class SegmentBatch:
+    """Per-ok segment tensors for the flat engine: (S, B, K) layouts."""
+
+    inv_proc: np.ndarray   # int32[S, B, K]
+    inv_tr: np.ndarray     # int32[S, B, K] — union transition ids
+    ok_proc: np.ndarray    # int32[S, B]
+    seg_index: np.ndarray  # int64[B, S] — segment → history index
+    depth: np.ndarray      # int32[S] — max pending depth across lanes
+
+
+def segment_batch(batch: PackedBatch) -> SegmentBatch:
+    """Compile each history's per-ok segments (union transition ids),
+    padded to a common (S, K)."""
+    segss = [LJ.make_segments(p) for p in batch.packeds]
+    S = _next_pow2(max((s.ok_proc.shape[0] for s in segss), default=1))
+    K = _next_pow2(max((s.inv_proc.shape[1] for s in segss),
+                       default=1), 2)
+    ips, its, ops, idxs, deps = [], [], [], [], []
+    for remap, s in zip(batch.remaps, segss):
+        ds, dk = S - s.ok_proc.shape[0], K - s.inv_proc.shape[1]
+        inv_proc = np.pad(s.inv_proc, ((0, ds), (0, dk)),
+                          constant_values=-1)
+        tr = np.pad(s.inv_tr, ((0, ds), (0, dk)))
+        mask = inv_proc >= 0
+        if remap.size:
+            tr[mask] = remap[tr[mask]]
+        ips.append(inv_proc)
+        its.append(tr)
+        ops.append(np.pad(s.ok_proc, (0, ds), constant_values=-1))
+        idxs.append(np.pad(s.seg_index, (0, ds)))
+        deps.append(np.pad(s.depth, (0, ds)))
+    return SegmentBatch(
+        inv_proc=np.stack(ips, axis=1),    # (S, B, K)
+        inv_tr=np.stack(its, axis=1),
+        ok_proc=np.stack(ops, axis=1),     # (S, B)
+        seg_index=np.stack(idxs, axis=0),  # (B, S)
+        depth=np.max(np.stack(deps, axis=0), axis=0),   # (S,)
+    )
 
 
 def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
-                batch_axis: str = "batch"):
+                batch_axis: str = "batch", engine: str = "auto"):
     """Run the batched device search; returns (status[N], fail_at[N],
-    n_final[N]) NumPy arrays. With ``mesh``, the batch axis is sharded
-    across devices (data parallelism over ICI)."""
+    n_final[N]) NumPy arrays — fail_at in history-index terms. With
+    ``mesh``, the batch axis is sharded across devices (data
+    parallelism over ICI).
+
+    engine: "keys" keeps the frontier as packed int32 key pairs —
+    config mutation is bit arithmetic, dedup one sort (fastest);
+    "flat" folds all frontiers into one explicit tensor with the batch
+    id as the top sort key; "vmap" is the per-lane fallback; "auto"
+    picks the best whose key budget fits.
+    """
     succ = LJ.pad_succ(batch.memo.succ,
                        _next_pow2(batch.memo.succ.shape[0]),
                        _next_pow2(batch.memo.succ.shape[1]))
     P = _next_pow2(batch.P, 2)
+    B = len(batch)
+    sizes = {"n_states": batch.memo.n_states,
+             "n_transitions": batch.memo.n_transitions}
+    if engine == "auto":
+        lay = LJ.KeyLayout(B, sizes["n_states"], sizes["n_transitions"],
+                           P)
+        if mesh is not None:
+            engine = "vmap"
+        elif lay.fits:
+            engine = "keys"
+        elif LJ.flat_pack_bits(B, sizes["n_states"],
+                               sizes["n_transitions"], P)[3]:
+            engine = "flat"
+        else:
+            engine = "vmap"
+    if engine in ("keys", "flat"):
+        sb = segment_batch(batch)
+        fn = (LJ.check_device_keys if engine == "keys"
+              else LJ.check_device_flat)
+        status, fail_seg, n_final = fn(
+            succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+            B=B, F=F, P=P, **sizes)
+        status = np.asarray(status)
+        fail_seg = np.asarray(fail_seg)
+        fail_at = np.array([
+            sb.seg_index[b, fail_seg[b]] if fail_seg[b] >= 0 else -1
+            for b in range(B)], np.int64)
+        return status, fail_at, np.asarray(n_final)
     if mesh is not None:
         out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc, batch.tr,
-                               F=F, P=P, batch_axis=batch_axis)
+                               F=F, P=P, batch_axis=batch_axis, **sizes)
     else:
         out = LJ.check_device_batch(succ, batch.kind, batch.proc, batch.tr,
-                                    F=F, P=P)
+                                    F=F, P=P, **sizes)
     return tuple(np.asarray(x) for x in out)

@@ -105,25 +105,32 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     P = len(packed.process_table)
     succ = LJ.pad_succ(mm.succ, _next_pow2(mm.succ.shape[0]),
                        _next_pow2(mm.succ.shape[1]))
-    stream = LJ.make_stream(packed, n_pad=_next_pow2(len(packed), 256))
+    segs = LJ.make_segments(packed)
+    segs = LJ.make_segments(
+        packed, s_pad=_next_pow2(segs.ok_proc.shape[0], 64),
+        k_pad=_next_pow2(segs.inv_proc.shape[1], 2))
     info: dict = {"backend": "device", "n_states": mm.n_states,
                   "n_transitions": mm.n_transitions}
     for F in capacities:
-        status, fail_at, n_final = LJ.check_device(
-            succ, *stream, F=F, P=_next_pow2(P, 2))
+        status, fail_seg, n_final = LJ.check_device_seg(
+            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+            F=F, P=_next_pow2(P, 2),
+            n_states=mm.n_states, n_transitions=mm.n_transitions)
         status = int(status)
         info["frontier_capacity"] = F
         if status != LJ.UNKNOWN:
             break
     info["time_s"] = time.monotonic() - t0
+    fail_at = (int(segs.seg_index[int(fail_seg)])
+               if int(fail_seg) >= 0 else -1)
     if status == LJ.VALID:
         return Analysis(valid=True, final_count=int(n_final), info=info)
     if status == LJ.UNKNOWN:
-        return Analysis(valid=UNKNOWN, op_index=int(fail_at),
+        return Analysis(valid=UNKNOWN, op_index=fail_at,
                         info={**info, "cause": "frontier overflow"})
     # invalid: decode counterexample context on host (the final-paths
     # role, linear.clj:180-212); bounded so it can't explode
-    op_index = int(fail_at)
+    op_index = fail_at
     op = packed.ops[op_index]
     cfgs: List[dict] = []
     try:

@@ -97,24 +97,93 @@ def pad_succ(succ: np.ndarray, s_pad: Optional[int] = None,
     return out
 
 
-def _dedup_compact(states, slots, valid, F):
-    """Sort rows into an exact lexicographic order (valid first), so
-    identical configs are guaranteed adjacent; drop duplicates.
-    Returns (states[F], slots[F,P], valid[F], n_unique, overflow)."""
+def _greedy_split(widths):
+    """Simulate the packers' greedy fill (lo from the field list's end,
+    hi takes the rest); returns (lo_bits, hi_bits). Fields never
+    straddle words, so the budget must be checked per word — summing
+    total bits alone misses fragmentation and would let fields shift
+    past bit 31, aliasing distinct configs."""
+    lo_bits = 0
+    i = len(widths) - 1
+    while i >= 0 and lo_bits + widths[i] <= 31:
+        lo_bits += widths[i]
+        i -= 1
+    return lo_bits, sum(widths[:i + 1])
+
+
+def pack_bits(n_states: int, n_transitions: int, P: int):
+    """Bit budget for packing one config (state + P slots) into two
+    int32 words. Returns (state_bits, slot_bits, fits); fits is False
+    when the greedy per-word split overflows (fall back to full
+    lexsort). Slot values live in [-2, T), stored as slot+2. hi must
+    stay below bit 30: the invalid sentinel is 1<<30 and must sort
+    after every valid key."""
+    state_bits = max(int(np.ceil(np.log2(max(n_states, 2)))), 1)
+    slot_bits = max(int(np.ceil(np.log2(max(n_transitions + 2, 2)))), 1)
+    _, hi_bits = _greedy_split([state_bits] + [slot_bits] * P)
+    fits = hi_bits <= 29 and state_bits <= 29 and slot_bits <= 29
+    return state_bits, slot_bits, fits
+
+
+def _pack_words(states, slots, state_bits: int, slot_bits: int):
+    """Exact (hi, lo) int32 fingerprint of each config row. Fields fill
+    lo from the least-significant end until 31 bits are used, then hi
+    (each word stays < 2^30 by the pack_bits budget)."""
     P = slots.shape[1]
-    # lexsort: last key is primary — valid rows first, then by full row
-    keys = tuple(slots[:, q] for q in range(P - 1, -1, -1)) \
-        + (states, ~valid)
-    order = jnp.lexsort(keys)
-    st, sl, va = states[order], slots[order], valid[order]
-    pad = jnp.zeros(1, bool)
-    same = jnp.concatenate([pad, (st[1:] == st[:-1])
-                            & jnp.all(sl[1:] == sl[:-1], axis=1)
-                            & va[:-1]])
+    fields = [(states, state_bits)] + \
+        [(slots[:, q] + 2, slot_bits) for q in range(P)]
+    lo = jnp.zeros_like(states)
+    lo_bits = 0
+    i = len(fields) - 1
+    while i >= 0 and lo_bits + fields[i][1] <= 31:
+        lo = lo | (fields[i][0] << lo_bits)
+        lo_bits += fields[i][1]
+        i -= 1
+    hi = jnp.zeros_like(states)
+    hi_bits = 0
+    while i >= 0:
+        hi = hi | (fields[i][0] << hi_bits)
+        hi_bits += fields[i][1]
+        i -= 1
+    return hi, lo
+
+
+def _dedup_compact(states, slots, valid, F, state_bits=None,
+                   slot_bits=None):
+    """Sort rows into an exact order (valid first) so identical configs
+    are guaranteed adjacent; drop duplicates.
+    Returns (states[F], slots[F,P], valid[F], n_unique, overflow).
+
+    With a bit budget (state_bits/slot_bits), rows pack losslessly into
+    two int32 words — a 2-key sort instead of P+2 stable sort passes;
+    otherwise falls back to the full lexicographic sort. Both are exact:
+    hash-fingerprint ordering is NOT sound here (colliding non-identical
+    rows can interleave between equal rows and break adjacency)."""
+    P = slots.shape[1]
+    if state_bits is not None:
+        hi, lo = _pack_words(states, slots, state_bits, slot_bits)
+        hi = jnp.where(valid, hi, jnp.int32(1) << 30)  # invalid last
+        order = jnp.lexsort((lo, hi))
+        h, l = hi[order], lo[order]
+        va = valid[order]
+        pad = jnp.zeros(1, bool)
+        same = jnp.concatenate([pad, (h[1:] == h[:-1])
+                                & (l[1:] == l[:-1]) & va[:-1]])
+    else:
+        # lexsort: last key is primary — valid rows first, full row order
+        keys = tuple(slots[:, q] for q in range(P - 1, -1, -1)) \
+            + (states, ~valid)
+        order = jnp.lexsort(keys)
+        st0, sl0, va = states[order], slots[order], valid[order]
+        pad = jnp.zeros(1, bool)
+        same = jnp.concatenate([pad, (st0[1:] == st0[:-1])
+                                & jnp.all(sl0[1:] == sl0[:-1], axis=1)
+                                & va[:-1]])
     keep = va & ~same
     n = jnp.sum(keep)
     order2 = jnp.argsort(~keep, stable=True)[:F]
-    return st[order2], sl[order2], keep[order2], n, n > F
+    sel = order[order2]
+    return states[sel], slots[sel], keep[order2], n, n > F
 
 
 def _expand(succ, states, slots, valid):
@@ -129,11 +198,18 @@ def _expand(succ, states, slots, valid):
     return s2.reshape(F * P), cand_slots.reshape(F * P, P), cand_valid
 
 
-def _closure(succ, states, slots, valid, n_valid, F, P):
-    """Fixed point of single-call linearization with dedup."""
+def _closure(succ, states, slots, valid, n_valid, F, P, bits,
+             max_iter=None):
+    """Fixed point of single-call linearization with dedup.
+    ``max_iter`` bounds iterations exactly (= pending-call count, the
+    longest possible linearization chain); defaults to the loose P+1
+    bound."""
+    if max_iter is None:
+        max_iter = P + 1
+
     def cond(c):
         _, _, _, _, changed, overflow, it = c
-        return changed & ~overflow & (it <= P)
+        return changed & ~overflow & (it < max_iter)
 
     def body(c):
         st, sl, va, n, _, _, it = c
@@ -141,7 +217,8 @@ def _closure(succ, states, slots, valid, n_valid, F, P):
         all_st = jnp.concatenate([st, c_st])
         all_sl = jnp.concatenate([sl, c_sl])
         all_va = jnp.concatenate([va, c_va])
-        st2, sl2, va2, n2, ovf = _dedup_compact(all_st, all_sl, all_va, F)
+        st2, sl2, va2, n2, ovf = _dedup_compact(all_st, all_sl, all_va,
+                                                F, *bits)
         return st2, sl2, va2, n2, n2 > n, ovf, it + 1
 
     init = body((states, slots, valid, n_valid,
@@ -150,7 +227,7 @@ def _closure(succ, states, slots, valid, n_valid, F, P):
     return st, sl, va, n, ovf
 
 
-def _make_step(succ, F, P):
+def _make_step(succ, F, P, bits):
     def step(carry, op):
         states, slots, valid, n, status, fail_at = carry
         kind, proc, tr, idx = op
@@ -160,7 +237,8 @@ def _make_step(succ, F, P):
                     status, fail_at)
 
         def do_ok(_):
-            st, sl, va, _, ovf = _closure(succ, states, slots, valid, n, F, P)
+            st, sl, va, _, ovf = _closure(succ, states, slots, valid, n,
+                                          F, P, bits)
             returned = va & (sl[:, proc] == LIN)
             sl2 = sl.at[:, proc].set(IDLE)
             n2 = jnp.sum(returned)
@@ -178,7 +256,8 @@ def _make_step(succ, F, P):
     return step
 
 
-def _check_impl(succ, kind, proc, tr, F: int, P: int):
+def _check_impl(succ, kind, proc, tr, F: int, P: int,
+                bits=(None, None)):
     n_ops = kind.shape[0]
     states = jnp.zeros(F, jnp.int32)
     slots = jnp.full((F, P), IDLE, jnp.int32)
@@ -186,35 +265,634 @@ def _check_impl(succ, kind, proc, tr, F: int, P: int):
     carry = (states, slots, valid, jnp.int32(1), jnp.int32(VALID),
              jnp.int32(-1))
     ops = (kind, proc, tr, jnp.arange(n_ops, dtype=jnp.int32))
-    step = _make_step(succ, F, P)
+    step = _make_step(succ, F, P, bits)
     (states, slots, valid, n, status, fail_at), _ = lax.scan(
         step, carry, ops)
     return status, fail_at, n
 
 
-@functools.partial(jax.jit, static_argnames=("F", "P"))
-def check_device(succ, kind, proc, tr, *, F: int, P: int):
+def _bits_for(n_states, n_transitions, P):
+    """Static pack budget, or (None, None) when packing doesn't fit."""
+    if n_states is None or n_transitions is None:
+        return (None, None)
+    sb, tb, fits = pack_bits(n_states, n_transitions, P)
+    return (sb, tb) if fits else (None, None)
+
+
+@functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
+                                             "n_transitions"))
+def check_device(succ, kind, proc, tr, *, F: int, P: int,
+                 n_states=None, n_transitions=None):
     """Run the full search for one history on device.
 
     Returns ``(status, fail_index, n_final)`` — status is VALID/INVALID/
     UNKNOWN; fail_index is the history index of the op at which the
-    frontier died (or overflowed)."""
-    return _check_impl(succ, kind, proc, tr, F, P)
+    frontier died (or overflowed). Passing the true (unpadded)
+    ``n_states``/``n_transitions`` enables the packed int32-pair dedup
+    fast path when the config fits 61 bits."""
+    bits = _bits_for(n_states, n_transitions, P)
+    return _check_impl(succ, kind, proc, tr, F, P, bits)
+
+
+# --- segmented stream: one device step per ok-op ---------------------------
+
+class SegmentStream(NamedTuple):
+    """Host-precompiled segments (see :func:`make_segments`): segment i
+    carries the invokes since the previous ok (padded to K) plus the
+    ok's process. ``seg_index`` maps segment → history index of its ok
+    (host-side, for decoding fail_at). ``depth`` is the number of
+    pending calls at the ok — the exact closure-iteration bound (a
+    linearization chain can't be longer than the pending set)."""
+    inv_proc: np.ndarray   # int32[S, K], -1 padding
+    inv_tr: np.ndarray     # int32[S, K]
+    ok_proc: np.ndarray    # int32[S]
+    seg_index: np.ndarray  # int64[S] (host side only)
+    depth: np.ndarray      # int32[S]
+
+
+def make_segments(packed, s_pad: Optional[int] = None,
+                  k_pad: Optional[int] = None) -> SegmentStream:
+    """Compress a history into per-ok segments.
+
+    The per-row scan spends a sequential step on every history row;
+    but only ok-ops change the frontier's validity — invokes just set a
+    slot, and fail/info rows are no-ops (``linear.clj:226``). Folding
+    each run of invokes into its following ok yields one device step
+    per ok-op (~3x fewer sequential steps). Invokes after the final ok
+    are dropped: a pending call can only *add* linearization orders,
+    never empty a non-empty frontier."""
+    from ..ops.op import INVOKE, OK, FAIL
+    n = len(packed)
+    segs: list = []
+    cur: list = []
+    pending: set = set()
+    for i in range(n):
+        t = int(packed.type[i])
+        p = int(packed.process[i])
+        if t == INVOKE and not packed.fails[i]:
+            cur.append((p, int(packed.trans[i])))
+            pending.add(p)
+        elif t == OK:
+            segs.append((cur, p, i, len(pending)))
+            pending.discard(p)
+            cur = []
+        elif t == FAIL:
+            pending.discard(p)
+    S = len(segs)
+    K = max((len(c) for c, _, _, _ in segs), default=1) or 1
+    k_pad = k_pad or K
+    s_pad = s_pad or S
+    assert k_pad >= K
+    inv_proc = np.full((s_pad, k_pad), -1, np.int32)
+    inv_tr = np.zeros((s_pad, k_pad), np.int32)
+    ok_proc = np.full(s_pad, -1, np.int32)   # -1 = padding segment
+    seg_index = np.zeros(s_pad, np.int64)
+    depth = np.zeros(s_pad, np.int32)
+    for s, (calls, okp, idx, dep) in enumerate(segs):
+        for k, (p, tr) in enumerate(calls):
+            inv_proc[s, k] = p
+            inv_tr[s, k] = tr
+        ok_proc[s] = okp
+        seg_index[s] = idx
+        depth[s] = dep
+    return SegmentStream(inv_proc, inv_tr, ok_proc, seg_index, depth)
+
+
+def _make_seg_step(succ, F, P, K, bits):
+    def step(carry, seg):
+        states, slots, valid, n, status, fail_at = carry
+        inv_proc, inv_tr, ok_proc, sidx, depth = seg
+
+        def run(_):
+            sl = slots
+            for k in range(K):      # unrolled: K is small and static
+                p = inv_proc[k]
+                sl = jnp.where(p >= 0,
+                               sl.at[:, jnp.maximum(p, 0)]
+                               .set(inv_tr[k]),
+                               sl)
+            st, sl2, va, _, ovf = _closure(succ, states, sl, valid, n,
+                                           F, P, bits, max_iter=depth)
+            returned = va & (sl2[:, ok_proc] == LIN)
+            sl3 = sl2.at[:, ok_proc].set(IDLE)
+            n2 = jnp.sum(returned)
+            st_new = jnp.where(ovf, UNKNOWN,
+                               jnp.where(n2 == 0, INVALID, VALID))
+            return (st, sl3, returned, n2, st_new.astype(jnp.int32),
+                    jnp.where(st_new == VALID, fail_at, sidx))
+
+        live = (status == VALID) & (ok_proc >= 0)
+        carry2 = lax.cond(live, run, lambda _: carry, None)
+        return carry2, None
+
+    return step
+
+
+def _check_impl_seg(succ, inv_proc, inv_tr, ok_proc, depth, F: int,
+                    P: int, bits=(None, None)):
+    S, K = inv_proc.shape
+    states = jnp.zeros(F, jnp.int32)
+    slots = jnp.full((F, P), IDLE, jnp.int32)
+    valid = jnp.zeros(F, bool).at[0].set(True)
+    carry = (states, slots, valid, jnp.int32(1), jnp.int32(VALID),
+             jnp.int32(-1))
+    segs = (inv_proc, inv_tr, ok_proc,
+            jnp.arange(S, dtype=jnp.int32), depth)
+    step = _make_seg_step(succ, F, P, K, bits)
+    (states, slots, valid, n, status, fail_at), _ = lax.scan(
+        step, carry, segs)
+    return status, fail_at, n
+
+
+@functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_seg(succ, inv_proc, inv_tr, ok_proc, depth, *, F: int,
+                     P: int, n_states=None, n_transitions=None):
+    """Segmented single-history search: one sequential device step per
+    ok-op. ``fail_at`` is a *segment* index — map through
+    ``SegmentStream.seg_index`` on host."""
+    bits = _bits_for(n_states, n_transitions, P)
+    return _check_impl_seg(succ, inv_proc, inv_tr, ok_proc, depth, F, P,
+                           bits)
+
+
+@functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_seg_batch(succ, inv_proc, inv_tr, ok_proc, depth, *,
+                           F: int, P: int, n_states=None,
+                           n_transitions=None):
+    bits = _bits_for(n_states, n_transitions, P)
+    fn = functools.partial(_check_impl_seg, F=F, P=P, bits=bits)
+    return jax.vmap(lambda a, b, c, d: fn(succ, a, b, c, d))(
+        inv_proc, inv_tr, ok_proc, depth)
+
+
+# --- flat-batch engine: B histories, one frontier tensor, no vmap ----------
+#
+# vmapping _check_impl lowers poorly on TPU (batched gathers/sorts cost
+# ~20x per lane); instead the B frontiers live in ONE flat (B*F)-row
+# tensor with the batch id packed into the top bits of the sort key.
+# Every step is then plain big-array ops: one 2-key sort, one cumsum,
+# gathers/scatters — exactly what the hardware is good at. Batch
+# boundaries after the sort are *fixed* (each batch contributes exactly
+# F*(P+1) rows, valid or not), so per-batch compaction is arithmetic on
+# row indices, not segmented reductions.
+
+def flat_pack_bits(B: int, n_states: int, n_transitions: int, P: int):
+    """Bit budget including the batch id + invalid flag. Returns
+    (batch_bits, state_bits, slot_bits, fits); simulates the same
+    greedy word split as :func:`_flat_sort_keys` so per-word overflow
+    (fragmentation) is caught, not just the total."""
+    batch_bits = max(int(np.ceil(np.log2(max(B, 2)))), 1)
+    state_bits = max(int(np.ceil(np.log2(max(n_states, 2)))), 1)
+    slot_bits = max(int(np.ceil(np.log2(max(n_transitions + 2, 2)))), 1)
+    widths = [batch_bits, 1, state_bits] + [slot_bits] * P
+    _, hi_bits = _greedy_split(widths)
+    fits = hi_bits <= 30 and all(b <= 30 for b in widths)
+    return batch_bits, state_bits, slot_bits, fits
+
+
+def _flat_sort_keys(batch, states, slots, valid, bits):
+    """(hi, lo) int32 keys: batch | invalid | state | slots, split so
+    each word stays below 31 bits. Invalid rows' state/slot fields are
+    zeroed: an invalid candidate carries state -1 from the expansion,
+    and a negative field would sign-corrupt the batch bits — pushing
+    the row across block boundaries and shifting other batches' valid
+    rows out of their fixed blocks."""
+    batch_bits, state_bits, slot_bits = bits
+    P = slots.shape[1]
+    st_f = jnp.where(valid, states, 0)
+    fields = [(batch, batch_bits), ((~valid).astype(jnp.int32), 1),
+              (st_f, state_bits)] + \
+        [(jnp.where(valid, slots[:, q] + 2, 0), slot_bits)
+         for q in range(P)]
+    lo = jnp.zeros_like(states)
+    lo_bits = 0
+    i = len(fields) - 1
+    while i >= 0 and lo_bits + fields[i][1] <= 31:
+        lo = lo | (fields[i][0] << lo_bits)
+        lo_bits += fields[i][1]
+        i -= 1
+    hi = jnp.zeros_like(states)
+    hi_bits = 0
+    while i >= 0:
+        hi = hi | (fields[i][0] << hi_bits)
+        hi_bits += fields[i][1]
+        i -= 1
+    return hi, lo
+
+
+def _flat_dedup_compact(batch, states, slots, valid, B, F, bits):
+    """Sort all rows by (batch, validity, config); dedup adjacent equal
+    configs; compact each batch's survivors into its F-row block.
+    Row count R per batch is fixed, so batch b owns sorted rows
+    [b*R, (b+1)*R). Returns (states, slots, valid, n_per_batch[B],
+    overflow[B]) with frontier shape (B*F, ...)."""
+    R = states.shape[0] // B
+    hi, lo = _flat_sort_keys(batch, states, slots, valid, bits)
+    order = jnp.lexsort((lo, hi))
+    h, l = hi[order], lo[order]
+    va = valid[order]
+    st = states[order]
+    sl = slots[order]
+    pad = jnp.zeros(1, bool)
+    same = jnp.concatenate([pad, (h[1:] == h[:-1]) & (l[1:] == l[:-1])
+                            & va[:-1]])
+    keep = va & ~same
+    c = jnp.cumsum(keep)                    # inclusive
+    e = c - keep                            # exclusive
+    row = jnp.arange(states.shape[0])
+    block = row // R
+    base = e.reshape(B, R)[:, 0]            # kept-count before each block
+    rank = e - base[block]
+    n_b = c.reshape(B, R)[:, -1] - base     # kept rows per batch
+    target = jnp.where(keep & (rank < F), block * F + rank, B * F)
+    out_st = jnp.zeros(B * F + 1, jnp.int32).at[target].set(st,
+                                                            mode="drop")
+    P = slots.shape[1]
+    out_sl = jnp.zeros((B * F + 1, P), jnp.int32).at[target].set(
+        sl, mode="drop")
+    slot_row = jnp.arange(B * F)
+    out_va = (slot_row % F) < jnp.minimum(n_b, F)[slot_row // F]
+    return (out_st[:B * F], out_sl[:B * F], out_va,
+            jnp.minimum(n_b, F), n_b > F)
+
+
+def _flat_closure(succ, batch, states, slots, valid, n_b, B, F, P, bits,
+                  max_iter=None):
+    """Fixed point of single-call linearization over the flat frontier.
+    All batches iterate in lockstep; the loop exits when no batch's
+    frontier grew (or the exact pending-depth bound is reached)."""
+    if max_iter is None:
+        max_iter = P + 1
+    cand_batch = jnp.arange(B * F * P, dtype=jnp.int32) // (F * P)
+    all_batch = jnp.concatenate([batch, cand_batch])
+
+    def cond(c):
+        _, _, _, _, _, changed, it = c
+        return changed & (it < max_iter)
+
+    def body(c):
+        st, sl, va, n, ovf_sticky, _, it = c
+        c_st, c_sl, c_va = _expand(succ, st, sl, va)
+        all_st = jnp.concatenate([st, c_st])
+        all_sl = jnp.concatenate([sl, c_sl])
+        all_va = jnp.concatenate([va, c_va])
+        st2, sl2, va2, n2, ovf = _flat_dedup_compact(
+            all_batch, all_st, all_sl, all_va, B, F, bits)
+        # overflow is sticky: a truncated frontier stays unsound for
+        # this batch even if later iterations fit again
+        ovf2 = ovf_sticky | ovf
+        changed = jnp.any(n2 > n) | jnp.any(ovf)
+        return st2, sl2, va2, n2, ovf2, changed, it + 1
+
+    init = body((states, slots, valid, n_b,
+                 jnp.zeros(B, bool), jnp.bool_(True), jnp.int32(0)))
+    st, sl, va, n, ovf, _, _ = lax.while_loop(cond, body, init)
+    return st, sl, va, n, ovf
+
+
+def _make_flat_step(succ, B, F, P, K, bits):
+    rows = jnp.arange(B * F, dtype=jnp.int32)
+    batch = rows // F
+
+    def step(carry, seg):
+        states, slots, valid, n_b, status, fail_at = carry
+        # (B,K),(B,K),(B,),(),()
+        inv_proc, inv_tr, ok_proc, sidx, depth = seg
+
+        live_b = (status == VALID) & (ok_proc >= 0)
+        live_row = live_b[batch]
+
+        sl = slots
+        for k in range(K):                       # K static, unrolled
+            p_row = inv_proc[batch, k]
+            tr_row = inv_tr[batch, k]
+            set_mask = live_row & (p_row >= 0)
+            col = jnp.maximum(p_row, 0)
+            sl = jnp.where(set_mask[:, None],
+                           sl.at[rows, col].set(
+                               jnp.where(set_mask, tr_row,
+                                         sl[rows, col])),
+                           sl)
+
+        st2, sl2, va2, n2, ovf = _flat_closure(
+            succ, batch, states, sl, valid, n_b, B, F, P, bits,
+            max_iter=depth)
+        okp_row = jnp.maximum(ok_proc, 0)[batch]
+        returned = va2 & (sl2[rows, okp_row] == LIN)
+        sl3 = sl2.at[rows, okp_row].set(
+            jnp.where(returned, IDLE, sl2[rows, okp_row]))
+        n3 = jnp.sum(returned.reshape(B, F), axis=1)
+
+        st_new = jnp.where(ovf, UNKNOWN,
+                           jnp.where(n3 == 0, INVALID, VALID)
+                           ).astype(jnp.int32)
+        status2 = jnp.where(live_b, st_new, status)
+        fail2 = jnp.where(live_b & (st_new != VALID), sidx, fail_at)
+
+        keep_row = live_row & (status2[batch] == VALID)
+        states_o = jnp.where(keep_row, st2, states)
+        slots_o = jnp.where(keep_row[:, None], sl3, slots)
+        valid_o = jnp.where(keep_row, returned, valid)
+        n_o = jnp.where(live_b & (status2 == VALID), n3, n_b)
+        return (states_o, slots_o, valid_o, n_o, status2, fail2), None
+
+    return step
+
+
+@functools.partial(jax.jit, static_argnames=("B", "F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_flat(succ, inv_proc, inv_tr, ok_proc, depth, *,
+                      B: int, F: int, P: int, n_states: int,
+                      n_transitions: int):
+    """Check B histories as one flat device computation.
+
+    seg arrays: inv_proc/inv_tr (S, B, K), ok_proc (S, B); returns
+    per-batch (status[B], fail_segment[B], n_final[B]). Requires the
+    packed-key budget to fit (see :func:`flat_pack_bits`)."""
+    bb, sb, tb, fits = flat_pack_bits(B, n_states, n_transitions, P)
+    assert fits, "flat engine requires the packed-key budget to fit"
+    bits = (bb, sb, tb)
+    S = inv_proc.shape[0]
+    K = inv_proc.shape[2]
+    rows = B * F
+    states = jnp.zeros(rows, jnp.int32)
+    slots = jnp.full((rows, P), IDLE, jnp.int32)
+    valid = (jnp.arange(rows) % F) == 0
+    carry = (states, slots, valid, jnp.ones(B, jnp.int32),
+             jnp.full(B, VALID, jnp.int32), jnp.full(B, -1, jnp.int32))
+    segs = (inv_proc, inv_tr, ok_proc, jnp.arange(S, dtype=jnp.int32),
+            depth)
+    step = _make_flat_step(succ, B, F, P, K, bits)
+    (states, slots, valid, n_b, status, fail_at), _ = lax.scan(
+        step, carry, segs)
+    return status, fail_at, n_b
+
+
+# --- key-packed flat engine: the frontier IS the sort key ------------------
+#
+# The fastest form: each config is ONLY its packed (hi, lo) int32 pair
+# — state and slots are bit fields, never materialized as arrays.
+# Invoking, linearizing, and returning ops are field arithmetic
+# (deltas shifted into place); deduplication sorts the keys themselves.
+# This removes the (rows, P, P) candidate materialization that
+# dominates the explicit-tensor engines (measured ~3x the cost of the
+# sort) and shrinks frontier memory from (P+1) words/row to 2.
+#
+# Field layout, LSB→MSB: slot_0 .. slot_{P-1}, state, invalid, batch —
+# split across lo (bits 0..30) then hi. Slot values: 0 = linearized
+# (LIN), 1 = idle (IDLE), t+2 = pending transition t. No field ever
+# crosses the word boundary; field deltas never borrow into neighbors
+# because every mutation keeps the field in range.
+
+class KeyLayout:
+    """Static (word, shift) assignment for each field."""
+
+    def __init__(self, B: int, n_states: int, n_transitions: int,
+                 P: int):
+        self.P = P
+        self.slot_bits = max(int(np.ceil(
+            np.log2(max(n_transitions + 2, 2)))), 1)
+        self.state_bits = max(int(np.ceil(
+            np.log2(max(n_states, 2)))), 1)
+        self.batch_bits = max(int(np.ceil(np.log2(max(B, 2)))), 1)
+        fields = ([("slot", q, self.slot_bits) for q in range(P)]
+                  + [("state", 0, self.state_bits),
+                     ("invalid", 0, 1),
+                     ("batch", 0, self.batch_bits)])
+        self.pos = {}
+        word, shift = 0, 0
+        for name, idx, width in fields:
+            if shift + width > 31:
+                word, shift = word + 1, 0
+            if width > 31 or word > 1:
+                self.fits = False
+                return
+            self.pos[(name, idx)] = (word, shift)
+            shift += width
+        self.fits = True
+        self.single_word = all(w == 0 for w, _ in self.pos.values())
+
+    def get(self, hi, lo, name, idx=0):
+        word, shift = self.pos[(name, idx)]
+        width = {"slot": self.slot_bits, "state": self.state_bits,
+                 "invalid": 1, "batch": self.batch_bits}[name]
+        src = lo if word == 0 else hi
+        return (src >> shift) & ((1 << width) - 1)
+
+    def add(self, hi, lo, name, idx, delta):
+        """Add a (possibly negative, data-dependent) delta to a field."""
+        word, shift = self.pos[(name, idx)]
+        if word == 0:
+            return hi, lo + (delta << shift)
+        return hi + (delta << shift), lo
+
+    def slot_dynamic(self, hi, lo, p):
+        """Extract slot p where p is a per-row tensor."""
+        out = jnp.zeros_like(lo)
+        for q in range(self.P):
+            out = jnp.where(p == q, self.get(hi, lo, "slot", q), out)
+        return out
+
+    def add_slot_dynamic(self, hi, lo, p, delta):
+        for q in range(self.P):
+            h2, l2 = self.add(hi, lo, "slot", q, delta)
+            hi = jnp.where(p == q, h2, hi)
+            lo = jnp.where(p == q, l2, lo)
+        return hi, lo
+
+
+def _k_dedup(hi, lo, valid, inv_hi, inv_lo, B, F, single_word: bool):
+    """Sort keys (invalid rows replaced by their batch's sentinel so
+    they stay in their block), dedup adjacent, compact per batch."""
+    R = hi.shape[0] // B
+    h = jnp.where(valid, hi, inv_hi)
+    l = jnp.where(valid, lo, inv_lo)
+    if single_word:
+        order = jnp.argsort(l)
+    else:
+        order = jnp.lexsort((l, h))
+    hs, ls = h[order], l[order]
+    va = valid[order]
+    pad = jnp.zeros(1, bool)
+    same = jnp.concatenate([pad, (hs[1:] == hs[:-1])
+                            & (ls[1:] == ls[:-1]) & va[:-1]])
+    keep = va & ~same
+    c = jnp.cumsum(keep)
+    e = c - keep
+    row = jnp.arange(hi.shape[0])
+    block = row // R
+    base = e.reshape(B, R)[:, 0]
+    rank = e - base[block]
+    n_b = c.reshape(B, R)[:, -1] - base
+    target = jnp.where(keep & (rank < F), block * F + rank, B * F)
+    out_hi = jnp.zeros(B * F + 1, jnp.int32).at[target].set(hs,
+                                                            mode="drop")
+    out_lo = jnp.zeros(B * F + 1, jnp.int32).at[target].set(ls,
+                                                            mode="drop")
+    slot_row = jnp.arange(B * F)
+    out_va = (slot_row % F) < jnp.minimum(n_b, F)[slot_row // F]
+    return (out_hi[:B * F], out_lo[:B * F], out_va,
+            jnp.minimum(n_b, F), n_b > F)
+
+
+def _k_expand(succ, lay: KeyLayout, hi, lo, valid):
+    """Candidate keys: for each pending slot q, linearize it — set the
+    slot field to LIN (0) and step the state field. Pure field
+    arithmetic; only the succ gather touches memory."""
+    s = lay.get(hi, lo, "state")
+    c_hi, c_lo, c_va = [], [], []
+    for q in range(lay.P):
+        tq = lay.get(hi, lo, "slot", q)
+        pending = tq >= 2
+        s2 = succ[s, jnp.maximum(tq - 2, 0)]
+        ok = valid & pending & (s2 >= 0)
+        h2, l2 = lay.add(hi, lo, "slot", q, -tq)       # slot -> LIN
+        h2, l2 = lay.add(h2, l2, "state", 0, s2 - s)
+        c_hi.append(h2)
+        c_lo.append(l2)
+        c_va.append(ok)
+    return (jnp.concatenate(c_hi), jnp.concatenate(c_lo),
+            jnp.concatenate(c_va))
+
+
+def _k_closure(succ, lay, hi, lo, valid, n_b, inv_hi_all, inv_lo_all,
+               B, F, max_iter=None):
+    P = lay.P
+    if max_iter is None:
+        max_iter = P + 1
+
+    def cond(c):
+        return c[5] & (c[6] < max_iter)
+
+    def body(c):
+        hi, lo, va, n, ovf_sticky, _, it = c
+        c_hi, c_lo, c_va = _k_expand(succ, lay, hi, lo, va)
+        a_hi = jnp.concatenate([hi, c_hi])
+        a_lo = jnp.concatenate([lo, c_lo])
+        a_va = jnp.concatenate([va, c_va])
+        hi2, lo2, va2, n2, ovf = _k_dedup(
+            a_hi, a_lo, a_va, inv_hi_all, inv_lo_all, B, F,
+            lay.single_word)
+        ovf2 = ovf_sticky | ovf
+        changed = jnp.any(n2 > n) | jnp.any(ovf)
+        return hi2, lo2, va2, n2, ovf2, changed, it + 1
+
+    init = body((hi, lo, valid, n_b, jnp.zeros(B, bool),
+                 jnp.bool_(True), jnp.int32(0)))
+    hi, lo, va, n, ovf, _, _ = lax.while_loop(cond, body, init)
+    return hi, lo, va, n, ovf
+
+
+@functools.partial(jax.jit, static_argnames=("B", "F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_keys(succ, inv_proc, inv_tr, ok_proc, depth, *,
+                      B: int, F: int, P: int, n_states: int,
+                      n_transitions: int):
+    """The key-packed flat engine: B histories, frontier = (hi, lo)
+    int32 pairs, one sort per closure iteration. Same inputs/outputs as
+    :func:`check_device_flat`."""
+    lay = KeyLayout(B, n_states, n_transitions, P)
+    assert lay.fits, "key layout must fit 62 bits"
+    S, _, K = inv_proc.shape
+    rows = jnp.arange(B * F, dtype=jnp.int32)
+    batch = rows // F
+
+    # per-row constants: the batch field and the invalid sentinel
+    bword, bshift = lay.pos[("batch", 0)]
+    ivword, ivshift = lay.pos[("invalid", 0)]
+    zero = jnp.zeros_like(rows)
+    if bword == 1:
+        base_hi, base_lo = batch << bshift, zero
+    else:
+        base_hi, base_lo = zero, batch << bshift
+    inv_hi_row = base_hi + ((1 << ivshift) if ivword == 1 else 0)
+    inv_lo_row = base_lo + ((1 << ivshift) if ivword == 0 else 0)
+    # candidates inherit row i -> frontier row i // P... but expansion
+    # concatenates per-q chunks: candidate chunk q holds rows 0..B*F in
+    # order, so its batch layout equals the frontier's, tiled P times
+    inv_hi_all = jnp.concatenate([inv_hi_row] * (P + 1))
+    inv_lo_all = jnp.concatenate([inv_lo_row] * (P + 1))
+
+    # initial frontier: one empty config per batch (all slots IDLE=1)
+    idle_lo = 0
+    idle_hi = 0
+    for q in range(P):
+        w, sh = lay.pos[("slot", q)]
+        if w == 0:
+            idle_lo |= 1 << sh
+        else:
+            idle_hi |= 1 << sh
+    hi0 = base_hi + idle_hi
+    lo0 = base_lo + idle_lo
+    valid0 = (jnp.arange(B * F) % F) == 0
+
+    def step(carry, seg):
+        hi, lo, va, n_b, status, fail_at = carry
+        inv_p, inv_t, ok_p, sidx, depth = seg
+
+        live_b = (status == VALID) & (ok_p >= 0)
+        live_row = live_b[batch]
+
+        h, l = hi, lo
+        for k in range(K):
+            p_row = inv_p[batch, k]
+            tr_row = inv_t[batch, k]
+            m = live_row & (p_row >= 0)
+            # slot p: IDLE (1) -> tr+2; delta = tr+1
+            h2, l2 = lay.add_slot_dynamic(h, l, jnp.maximum(p_row, 0),
+                                          tr_row + 1)
+            h = jnp.where(m, h2, h)
+            l = jnp.where(m, l2, l)
+
+        h2, l2, va2, n2, ovf = _k_closure(succ, lay, h, l, va, n_b,
+                                          inv_hi_all, inv_lo_all, B, F,
+                                          max_iter=depth)
+        okp_row = jnp.maximum(ok_p, 0)[batch]
+        slot_ok = lay.slot_dynamic(h2, l2, okp_row)
+        returned = va2 & (slot_ok == 0)                 # LIN
+        h3, l3 = lay.add_slot_dynamic(h2, l2, okp_row,
+                                      jnp.where(returned, 1, 0))
+        n3 = jnp.sum(returned.reshape(B, F), axis=1)
+
+        st_new = jnp.where(ovf, UNKNOWN,
+                           jnp.where(n3 == 0, INVALID, VALID)
+                           ).astype(jnp.int32)
+        status2 = jnp.where(live_b, st_new, status)
+        fail2 = jnp.where(live_b & (st_new != VALID), sidx, fail_at)
+        keep_row = live_row & (status2[batch] == VALID)
+        hi_o = jnp.where(keep_row, h3, hi)
+        lo_o = jnp.where(keep_row, l3, lo)
+        va_o = jnp.where(keep_row, returned, va)
+        n_o = jnp.where(live_b & (status2 == VALID), n3, n_b)
+        return (hi_o, lo_o, va_o, n_o, status2, fail2), None
+
+    carry = (hi0, lo0, valid0, jnp.ones(B, jnp.int32),
+             jnp.full(B, VALID, jnp.int32), jnp.full(B, -1, jnp.int32))
+    segs = (inv_proc, inv_tr, ok_proc, jnp.arange(S, dtype=jnp.int32),
+            depth)
+    (hi, lo, va, n_b, status, fail_at), _ = lax.scan(step, carry, segs)
+    return status, fail_at, n_b
 
 
 # --- batched (independent histories) ---------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("F", "P"))
-def check_device_batch(succ, kind, proc, tr, *, F: int, P: int):
+@functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_batch(succ, kind, proc, tr, *, F: int, P: int,
+                       n_states=None, n_transitions=None):
     """vmap over a batch of histories sharing one successor table — the
     TPU analog of ``independent/checker``'s per-key partitioning
     (``independent.clj:252-300``): thousands of per-key histories check
     in one launch."""
-    fn = functools.partial(_check_impl, succ, F=F, P=P)
+    bits = _bits_for(n_states, n_transitions, P)
+    fn = functools.partial(_check_impl, succ, F=F, P=P, bits=bits)
     return jax.vmap(fn)(kind, proc, tr)
 
 
 def check_sharded(mesh, succ, kind, proc, tr, *, F: int, P: int,
+                  n_states=None, n_transitions=None,
                   batch_axis: str = "batch"):
     """Shard a batch of independent histories across a device mesh: the
     batch axis rides data parallelism over ICI; each device runs whole
@@ -227,4 +905,6 @@ def check_sharded(mesh, succ, kind, proc, tr, *, F: int, P: int,
     proc = jax.device_put(proc, batch_sh)
     tr = jax.device_put(tr, batch_sh)
     succ = jax.device_put(succ, repl)
-    return check_device_batch(succ, kind, proc, tr, F=F, P=P)
+    return check_device_batch(succ, kind, proc, tr, F=F, P=P,
+                              n_states=n_states,
+                              n_transitions=n_transitions)

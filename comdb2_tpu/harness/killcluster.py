@@ -108,7 +108,9 @@ def run(test: dict,
     out = {"valid?": not diff, "diff": diff,
            "lines": len(lines), "expected-lines": len(expected)}
     if errors:
-        # a crashed client is not evidence of data loss — surface it
-        out["valid?"] = "unknown" if not diff else False
+        # a crashed client truncates the transcript, which always
+        # diffs — that is not evidence of data loss; the verdict is
+        # unknown either way, with the cause attached
+        out["valid?"] = "unknown"
         out["error"] = repr(errors[0])
     return out

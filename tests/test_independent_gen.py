@@ -1,0 +1,177 @@
+"""Independent generators, reconnect wrapper, OS setup tests."""
+
+import threading
+
+import pytest
+
+from comdb2_tpu import control
+from comdb2_tpu.control.remote import RecordingRemote
+from comdb2_tpu.control import reconnect
+from comdb2_tpu.harness import generator as G
+from comdb2_tpu.harness import independent_gen as IG
+from comdb2_tpu.harness import os_setup
+from comdb2_tpu.ops.kv import KVTuple
+
+TEST = {"concurrency": 4}
+
+
+def test_sequential_generator_wraps_and_advances():
+    g = IG.sequential_generator(
+        [1, 2], lambda k: G.limit(2, {"type": "invoke", "f": "read",
+                                      "value": None}))
+    vals = []
+    while True:
+        o = G.op(g, TEST, 0)
+        if o is None:
+            break
+        vals.append(o["value"])
+    assert vals == [KVTuple(1, None)] * 2 + [KVTuple(2, None)] * 2
+    assert all(isinstance(v, KVTuple) for v in vals)
+
+
+def test_concurrent_generator_groups():
+    # 4 threads, 2 per key -> 2 groups
+    seen = {}
+    lock = threading.Lock()
+
+    def fgen(k):
+        return G.limit(4, {"type": "invoke", "f": "w", "value": k})
+
+    g = IG.concurrent_generator(2, iter(range(10)), fgen)
+
+    def worker(tid):
+        with G.with_threads([0, 1, 2, 3]):
+            while True:
+                o = g.op(TEST, tid)
+                if o is None:
+                    return
+                with lock:
+                    seen.setdefault(tid, set()).add(o["value"].key)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # group 0 = threads {0,1}, group 1 = threads {2,3}; keys alternate
+    # between groups, and a thread only ever sees its group's keys
+    keys01 = seen.get(0, set()) | seen.get(1, set())
+    keys23 = seen.get(2, set()) | seen.get(3, set())
+    assert keys01 and keys23
+    assert keys01.isdisjoint(keys23)
+    assert keys01 | keys23 == set(range(10))
+
+
+def test_concurrent_generator_asserts_divisibility():
+    g = IG.concurrent_generator(3, [1], lambda k: G.void)
+    with G.with_threads([0, 1, 2, 3]):
+        with pytest.raises(AssertionError, match="multiple of 3"):
+            g.op(TEST, 0)
+
+
+def test_concurrent_generator_rejects_nemesis():
+    g = IG.concurrent_generator(2, [1], lambda k: G.void)
+    with G.with_threads([G.NEMESIS, 0, 1, 2, 3]):
+        with pytest.raises(AssertionError, match="integer worker"):
+            g.op(TEST, "nemesis")
+
+
+def test_full_run_with_concurrent_generator(tmp_path):
+    """register test lifted over 3 keys with 2 threads per key."""
+    from comdb2_tpu.checker import checkers as C
+    from comdb2_tpu.checker import independent as I
+    from comdb2_tpu.harness import core, fake
+    from comdb2_tpu.models import model as M
+
+    states = {}
+    lock = threading.Lock()
+
+    class KeyedClient(fake.client_ns.Client):
+        def invoke(self, test, op):
+            k, v = op["value"]
+            with lock:
+                cur = states.get(k)
+                if op["f"] == "write":
+                    states[k] = v
+                    return {**op, "type": "ok"}
+                if op["f"] == "read":
+                    from comdb2_tpu.ops.kv import tuple_
+                    return {**op, "type": "ok", "value": tuple_(k, cur)}
+            raise ValueError(op["f"])
+
+    import random
+
+    def fgen(k):
+        return G.limit(8, lambda t, p: {
+            "type": "invoke",
+            "f": random.choice(["read", "write"]),
+            "value": random.randrange(3)})
+
+    t = fake.noop_test()
+    t.update({
+        "nodes": [], "concurrency": 6, "name": "indep-gen",
+        "store-root": str(tmp_path / "store"),
+        "client": KeyedClient(),
+        "model": M.register(),
+        "generator": G.clients(
+            IG.concurrent_generator(2, range(3), fgen)),
+        "checker": I.checker(C.Linearizable()),
+    })
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+    assert set(result["results"]["results"]) == {0, 1, 2}
+
+
+# --- reconnect --------------------------------------------------------------
+
+def test_reconnect_reopens_after_failure():
+    opens = []
+
+    class FragileConn:
+        def __init__(self, gen_):
+            self.gen = gen_
+            self.alive = True
+
+    def open_fn():
+        opens.append(1)
+        return FragileConn(len(opens))
+
+    closed = []
+    w = reconnect.wrapper(open_fn, lambda c: closed.append(c.gen))
+    assert w.with_conn(lambda c: c.gen) == 1
+    assert w.with_conn(lambda c: c.gen) == 1      # reused
+
+    def boom(c):
+        raise IOError("dropped")
+
+    with pytest.raises(IOError):
+        w.with_conn(boom)
+    assert closed == [1]
+    assert w.with_conn(lambda c: c.gen) == 2      # reopened
+
+    # with_retry succeeds across a transient failure
+    calls = []
+
+    def flaky(c):
+        calls.append(c.gen)
+        if len(calls) == 1:
+            raise IOError("once")
+        return c.gen
+
+    assert w.with_retry(flaky, retries=3, delay=0) == 3
+
+
+# --- os ---------------------------------------------------------------------
+
+def test_debian_os_setup_commands():
+    rec = RecordingRemote()
+    test = {"nodes": ["n1"], "remote": rec}
+    os_ = os_setup.DebianOS(packages=["ntpdate", "iptables"],
+                            node_ips={"n1": "10.0.0.1",
+                                      "n2": "10.0.0.2"})
+    control.on_nodes(test, os_.setup)
+    cmds = [c for _, c in rec.commands]
+    assert any("/etc/hostname" in c for c in cmds)
+    assert any("10.0.0.2 n2" in c for c in cmds)
+    assert any("apt-get install -y ntpdate iptables" in c for c in cmds)
