@@ -17,9 +17,15 @@ reference under ``linearizable/jepsen/src/``) as a TPU-first framework:
 - ``comdb2_tpu.parallel`` — device meshes, batching of independent
   histories, sharded execution.
 - ``comdb2_tpu.harness``  — the test runtime: generators, clients,
-  workers, nemesis scheduling, the results store, and the CLI.
+  workers, nemesis scheduling, the results store, web UI, killcluster
+  oracle, and the CLI.
 - ``comdb2_tpu.control``  — the control plane: remote execution, network
   partitions, clock and process faults.
+- ``comdb2_tpu.workloads`` — the comdb2 test suite over a table-level
+  serializable connection interface (+ in-memory chaos backend).
+- ``comdb2_tpu.report``   — latency/rate SVG graphs, HTML timelines,
+  counterexample rendering.
+- ``comdb2_tpu.filetest`` — offline history checker CLI.
 """
 
 __version__ = "0.1.0"

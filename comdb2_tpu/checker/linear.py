@@ -55,12 +55,19 @@ def analysis(model: Model,
              capacities: Sequence[int] = (64, 1024, 8192, 65536),
              host_threshold: int = 128,
              max_states: int = 1 << 20,
-             max_host_configs: int = 1 << 22) -> Analysis:
+             max_host_configs: int = 1 << 22,
+             progress=None,
+             progress_interval_s: float = 5.0) -> Analysis:
     """Check ``history`` against ``model`` for linearizability.
 
     backend: "auto" | "host" | "device".
     capacities: device frontier sizes tried in order; overflow escalates,
     overflow at the last yields :unknown.
+    progress: optional callback ``progress(done_segments, total_segments,
+    frontier_count)`` invoked between device chunks at roughly
+    ``progress_interval_s`` cadence — the role of the reference's
+    5-second reporter threads (``linear.clj:273-297``). When given, the
+    device path runs chunked.
     """
     t0 = time.monotonic()
     packed = (history if isinstance(history, PackedHistory)
@@ -77,7 +84,9 @@ def analysis(model: Model,
 
     if backend == "host" or (backend == "auto" and n < host_threshold):
         return _analyze_host(mm, packed, max_host_configs, t0)
-    return _analyze_device(mm, packed, capacities, t0)
+    return _analyze_device(mm, packed, capacities, t0,
+                           progress=progress,
+                           progress_interval_s=progress_interval_s)
 
 
 def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
@@ -99,23 +108,62 @@ def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
 
 
 def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
-                    capacities: Sequence[int], t0: float) -> Analysis:
+                    capacities: Sequence[int], t0: float,
+                    progress=None,
+                    progress_interval_s: float = 5.0) -> Analysis:
+    import numpy as np
+
     from . import linear_jax as LJ
 
+    import jax
+
     P = len(packed.process_table)
-    succ = LJ.pad_succ(mm.succ, _next_pow2(mm.succ.shape[0]),
-                       _next_pow2(mm.succ.shape[1]))
+    # ship the successor table once — chunked runs and capacity
+    # escalation reuse the same device buffer
+    succ = jax.device_put(LJ.pad_succ(mm.succ,
+                                      _next_pow2(mm.succ.shape[0]),
+                                      _next_pow2(mm.succ.shape[1])))
     segs = LJ.make_segments(packed)
+    s_real = segs.ok_proc.shape[0]
     segs = LJ.make_segments(
-        packed, s_pad=_next_pow2(segs.ok_proc.shape[0], 64),
+        packed, s_pad=_next_pow2(s_real, 64),
         k_pad=_next_pow2(segs.inv_proc.shape[1], 2))
     info: dict = {"backend": "device", "n_states": mm.n_states,
                   "n_transitions": mm.n_transitions}
+    sizes = {"n_states": mm.n_states, "n_transitions": mm.n_transitions}
+    P2 = _next_pow2(P, 2)
     for F in capacities:
-        status, fail_seg, n_final = LJ.check_device_seg(
-            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
-            F=F, P=_next_pow2(P, 2),
-            n_states=mm.n_states, n_transitions=mm.n_transitions)
+        if progress is None:
+            status, fail_seg, n_final = LJ.check_device_seg(
+                succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
+                segs.depth, F=F, P=P2, **sizes)
+        else:
+            # chunked: report between device calls at ~interval cadence
+            S = segs.ok_proc.shape[0]
+            chunk = max(_next_pow2(min(S, 2048)), 64)
+            carry = LJ.init_seg_carry(F, P2)
+            last = time.monotonic()
+            done = 0
+            while done < S:
+                end = min(done + chunk, S)
+                pad = chunk - (end - done)
+                ip = np.pad(segs.inv_proc[done:end],
+                            ((0, pad), (0, 0)), constant_values=-1)
+                it = np.pad(segs.inv_tr[done:end], ((0, pad), (0, 0)))
+                op_ = np.pad(segs.ok_proc[done:end], (0, pad),
+                             constant_values=-1)
+                dp = np.pad(segs.depth[done:end], (0, pad))
+                carry = LJ.check_device_seg_chunk(
+                    succ, ip, it, op_, dp, done, carry, F=F, P=P2,
+                    **sizes)
+                done = end
+                if int(carry[4]) != LJ.VALID:
+                    break
+                now = time.monotonic()
+                if now - last >= progress_interval_s:
+                    progress(min(done, s_real), s_real, int(carry[3]))
+                    last = now
+            status, fail_seg, n_final = carry[4], carry[5], carry[3]
         status = int(status)
         info["frontier_capacity"] = F
         if status != LJ.UNKNOWN:

@@ -391,11 +391,7 @@ def _make_seg_step(succ, F, P, K, bits):
 def _check_impl_seg(succ, inv_proc, inv_tr, ok_proc, depth, F: int,
                     P: int, bits=(None, None)):
     S, K = inv_proc.shape
-    states = jnp.zeros(F, jnp.int32)
-    slots = jnp.full((F, P), IDLE, jnp.int32)
-    valid = jnp.zeros(F, bool).at[0].set(True)
-    carry = (states, slots, valid, jnp.int32(1), jnp.int32(VALID),
-             jnp.int32(-1))
+    carry = init_seg_carry(F, P)
     segs = (inv_proc, inv_tr, ok_proc,
             jnp.arange(S, dtype=jnp.int32), depth)
     step = _make_seg_step(succ, F, P, K, bits)
@@ -414,6 +410,35 @@ def check_device_seg(succ, inv_proc, inv_tr, ok_proc, depth, *, F: int,
     bits = _bits_for(n_states, n_transitions, P)
     return _check_impl_seg(succ, inv_proc, inv_tr, ok_proc, depth, F, P,
                            bits)
+
+
+def init_seg_carry(F: int, P: int):
+    """Initial scan carry for the chunked segmented search."""
+    states = jnp.zeros(F, jnp.int32)
+    slots = jnp.full((F, P), IDLE, jnp.int32)
+    valid = jnp.zeros(F, bool).at[0].set(True)
+    return (states, slots, valid, jnp.int32(1), jnp.int32(VALID),
+            jnp.int32(-1))
+
+
+@functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_seg_chunk(succ, inv_proc, inv_tr, ok_proc, depth,
+                           seg_offset, carry, *, F: int, P: int,
+                           n_states=None, n_transitions=None):
+    """One chunk of the segmented search: consumes ``carry`` (from
+    :func:`init_seg_carry` or a previous chunk) and returns the updated
+    carry. Chunking lets the host report progress between device calls
+    — the role of the reference's 5-second reporter threads
+    (``knossos/linear.clj:273-297``). ``seg_offset`` biases the segment
+    indices recorded in ``fail_at``."""
+    bits = _bits_for(n_states, n_transitions, P)
+    S = inv_proc.shape[0]
+    segs = (inv_proc, inv_tr, ok_proc,
+            seg_offset + jnp.arange(S, dtype=jnp.int32), depth)
+    step = _make_seg_step(succ, F, P, inv_proc.shape[1], bits)
+    carry2, _ = lax.scan(step, carry, segs)
+    return carry2
 
 
 @functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
