@@ -28,14 +28,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                    choices=sorted(MODELS),
                    help="consistency model (default cas-register)")
     p.add_argument("--checker", default="linear",
-                   choices=["linear", "set"],
-                   help="linear (knossos) or set semantics")
+                   choices=["linear", "set", "wgl"],
+                   help="linear (frontier search), wgl (world search), "
+                        "or set semantics")
     p.add_argument("--backend", default="auto",
                    choices=["auto", "host", "device"])
     p.add_argument("--keyed", action="store_true",
                    help="re-tag [k v] op values as keyed tuples "
                         "(independent-generator histories)")
     args = p.parse_args(argv)
+
+    if args.checker == "linear" and args.backend != "host":
+        # only the device frontier search needs a JAX backend; the set
+        # and wgl checkers (and host linear) are pure host Python, and
+        # in the ambient env touching jax attaches the tunneled TPU
+        from .utils.platform import ensure_backend
+
+        ensure_backend()
 
     with open(args.history) as fh:
         history = parse_history(fh.read())
@@ -49,6 +58,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.checker == "set":
         result = set_checker.check({}, None, history)
+        pprint.pprint(result)
+        valid = result.get("valid?")
+    elif args.checker == "wgl":
+        from .checker import wgl
+
+        result = wgl.analysis(MODELS[args.model](), history)
         pprint.pprint(result)
         valid = result.get("valid?")
     else:

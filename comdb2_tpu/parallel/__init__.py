@@ -35,6 +35,9 @@ def check_histories_sharded(histories, model, mesh=None, F: int = 256,
     mesh = mesh if mesh is not None else make_mesh(axis=axis)
     histories = list(histories)
     n = len(histories)
+    if n == 0:
+        empty = np.zeros(0, np.int64)
+        return empty.astype(np.int32), empty, empty.astype(np.int32)
     # the batch axis must divide evenly across mesh devices; pad with
     # copies of the first history and slice the results back
     n_dev = mesh.devices.size

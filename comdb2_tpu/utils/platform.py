@@ -1,0 +1,21 @@
+"""JAX platform fallback.
+
+The ambient environment may pin ``JAX_PLATFORMS`` to a plugin backend
+(a tunneled TPU) that only registers under specific launch conditions;
+offline tools must degrade to CPU instead of crashing with "unknown
+backend"."""
+
+from __future__ import annotations
+
+
+def ensure_backend() -> str:
+    """Make sure some JAX backend initializes; falls back to CPU when
+    the configured platform can't. Returns the backend name."""
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    return jax.default_backend()

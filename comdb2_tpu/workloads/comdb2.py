@@ -305,29 +305,31 @@ class G2Client(client_ns.Client):
         return {**op, "type": "ok"}
 
 
-class G2Gen(gen.Generator):
-    """Pairs of inserts per fresh key, globally unique ids
-    (``adya.clj:14-55``)."""
+def g2_gen():
+    """Concurrent unique keys, two inserts per key with globally unique
+    ids, 2 threads per key — the reference's shape exactly
+    (``adya.clj:14-55``: ``independent/concurrent-generator 2 (range)``
+    over a two-op seq)."""
+    import itertools
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._key = 0
-        self._id = 0
-        self._pending = []
+    from ..harness import independent_gen as IG
 
-    def op(self, test, process):
-        with self._lock:
-            if not self._pending:
-                self._key += 1
-                self._id += 2
-                k = self._key
-                self._pending = [
-                    {"type": "invoke", "f": "insert",
-                     "value": I.tuple_(k, (None, self._id - 1))},
-                    {"type": "invoke", "f": "insert",
-                     "value": I.tuple_(k, (self._id, None))},
-                ]
-            return self._pending.pop()
+    ids = itertools.count(1)
+    lock = threading.Lock()
+
+    def next_id():
+        with lock:
+            return next(ids)
+
+    def fgen(k):
+        return gen.seq([
+            lambda t, p: {"type": "invoke", "f": "insert",
+                          "value": (None, next_id())},
+            lambda t, p: {"type": "invoke", "f": "insert",
+                          "value": (next_id(), None)},
+        ])
+
+    return IG.concurrent_generator(2, itertools.count(1), fgen)
 
 
 # --- test builders (core.clj:195-208,567-613) -------------------------------
@@ -488,7 +490,7 @@ def g2_test(opts: Optional[dict] = None,
         "name": "g2",
         "client": G2Client(connect),
         "concurrency": 10,
-        "generator": gen.clients(gen.limit(ops, G2Gen())),
+        "generator": gen.clients(gen.limit(ops, g2_gen())),
         "checker": g2_checker,
     })
     t.update(opts or {})

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Loop the native set-insert workload; the driver self-verifies its
+# per-value state machine (lost/unexpected => nonzero), and the Python
+# set checker re-verifies the emitted history — the role of the
+# reference's linearizable/ctest/insertloop.sh.
+#
+# Usage: scripts/insertloop.sh [runs] [driver-args...]
+set -u
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+INSERT="${INSERT:-$ROOT/native/build/ct_insert}"
+RUNS="${1:-0}"
+shift 2>/dev/null || true
+
+[ -x "$INSERT" ] || {
+    cmake -S "$ROOT/native" -B "$ROOT/native/build" >/dev/null \
+        && cmake --build "$ROOT/native/build" >/dev/null || exit 2
+}
+
+n=0
+while [ "$RUNS" -eq 0 ] || [ "$n" -lt "$RUNS" ]; do
+    n=$((n + 1))
+    hist="$(mktemp /tmp/insert-hist-XXXX.edn)"
+    echo "=== run $n" >&2
+    "$INSERT" -j "$hist" "$@" || {
+        echo "insert driver detected loss; history at $hist" >&2
+        exit 1
+    }
+    PYTHONPATH="$ROOT" python -m comdb2_tpu.filetest "$hist" \
+        --checker set || {
+        echo "set checker disagrees; history at $hist" >&2
+        exit 1
+    }
+    rm -f "$hist"
+done
+echo "all $n runs valid" >&2

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Re-run the native register workload + offline TPU check in a loop,
+# failing on the first invalid analysis — the role of the reference's
+# linearizable/ctest/registerloop.sh + jepsenloop.sh outer driver
+# (heal, run, grep for "Analysis invalid!", repeat).
+#
+# Usage: scripts/registerloop.sh [runs] [driver-args...]
+#   REGISTER=path     override the driver binary
+#   FILETEST="..."    override the checker command
+set -u
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+REGISTER="${REGISTER:-$ROOT/native/build/ct_register}"
+FILETEST="${FILETEST:-python -m comdb2_tpu.filetest}"
+RUNS="${1:-0}"   # 0 = forever
+shift 2>/dev/null || true
+
+[ -x "$REGISTER" ] || {
+    echo "building native drivers..." >&2
+    cmake -S "$ROOT/native" -B "$ROOT/native/build" >/dev/null \
+        && cmake --build "$ROOT/native/build" >/dev/null || exit 2
+}
+
+n=0
+while [ "$RUNS" -eq 0 ] || [ "$n" -lt "$RUNS" ]; do
+    n=$((n + 1))
+    hist="$(mktemp /tmp/register-hist-XXXX.edn)"
+    echo "=== run $n: $REGISTER -j $hist $*" >&2
+    "$REGISTER" -j "$hist" "$@" || { echo "driver failed" >&2; exit 2; }
+    PYTHONPATH="$ROOT" $FILETEST "$hist"
+    rc=$?
+    if [ $rc -eq 1 ]; then
+        echo "Analysis invalid! history kept at $hist" >&2
+        exit 1
+    elif [ $rc -ne 0 ] && [ $rc -ne 2 ]; then
+        echo "checker crashed (rc=$rc); history kept at $hist" >&2
+        exit 3
+    fi
+    rm -f "$hist"
+done
+echo "all $n runs valid" >&2
