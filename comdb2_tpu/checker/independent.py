@@ -79,6 +79,7 @@ class IndependentChecker(Checker):
         else:
             results = {k: check_safe(self.base, test, model, subs[k], opts)
                        for k in ks}
+        self._write_artifacts(test, subs, results, opts)
         # false > unknown > true, like compose; only definitively-invalid
         # keys are failures (the reference treats :unknown as truthy,
         # independent.clj:288-295)
@@ -86,6 +87,36 @@ class IndependentChecker(Checker):
         failures = [k for k, r in results.items()
                     if r.get("valid?") is False]
         return {"valid?": valid, "results": results, "failures": failures}
+
+    def _write_artifacts(self, test, subs, results, opts) -> None:
+        """Persist per-key results.edn + history.edn under
+        ``independent/<k>/`` in the test's store dir when one exists
+        (``independent.clj:272-283``); best-effort."""
+        import os
+
+        base = (opts or {}).get("dir") or (test or {}).get("dir")
+        if base is None and (test or {}).get("name") \
+                and test.get("start-time"):
+            from ..harness import store
+            base = store.path(test)
+        if base is None:
+            return
+        from ..harness.store import _edn_safe
+        from ..ops.edn import write_edn
+        from ..ops.history import history_to_edn
+
+        try:
+            for k, r in results.items():
+                d = os.path.join(base, "independent", str(k))
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "results.edn"), "w") as fh:
+                    fh.write(write_edn(_edn_safe(r)))
+                with open(os.path.join(d, "history.edn"), "w") as fh:
+                    fh.write(history_to_edn(subs[k]))
+        except Exception:
+            # genuinely best-effort: an unserializable payload must not
+            # turn an already-computed verdict into :unknown
+            pass
 
     def _check_linearizable_batch(self, model, subs: Dict[Any, List[Op]]
                                   ) -> Dict[Any, dict]:

@@ -728,18 +728,66 @@ class KeyLayout:
         return hi, lo
 
 
+import os as _os
+
+# optional Pallas bitonic sort for the dedup (correct and ~at parity
+# with XLA's variadic sort on v5e; kept opt-in until it wins clearly)
+_USE_PALLAS_SORT = _os.environ.get("COMDB2_TPU_PALLAS_SORT") == "1"
+
+
+def _batch_contig_perm(B, F, R):
+    """Row permutation gathering each batch's rows (frontier + P
+    candidate chunks, each F-blocked per batch) into contiguous
+    (B, R) blocks."""
+    idx = jnp.arange(B * R)
+    b = idx // R
+    rem = idx % R
+    c = rem // F
+    r = rem % F
+    return c * (B * F) + b * F + r
+
+
 def _k_dedup(hi, lo, valid, inv_hi, inv_lo, B, F, single_word: bool):
     """Sort keys (invalid rows replaced by their batch's sentinel so
     they stay in their block), dedup adjacent, compact per batch."""
     R = hi.shape[0] // B
     h = jnp.where(valid, hi, inv_hi)
     l = jnp.where(valid, lo, inv_lo)
-    if single_word:
-        order = jnp.argsort(l)
+    n_rows = hi.shape[0]
+    use_pallas = False
+    if (_USE_PALLAS_SORT and not single_word
+            and n_rows % B == 0 and (R & (R - 1)) == 0):
+        from . import pallas_sort as PS
+
+        use_pallas = PS.sort_pairs_available()   # cached probe
+    if use_pallas:
+        # per-block bitonic sort in VMEM; validity rides in the keys
+        # (sentinels sort to each block's tail), so sorting values
+        # directly replaces the argsort+gather pair
+        from . import pallas_sort as PS
+
+        from . import pallas_sort as PS
+
+        # the per-block sort needs batch-contiguous rows; the concat
+        # layout interleaves batches (frontier + P candidate chunks,
+        # each F-blocked), so gather into (B, R) blocks first
+        perm = _batch_contig_perm(B, F, R)
+        hs2, ls2 = PS.sort_pairs(h[perm].reshape(B, R),
+                                 l[perm].reshape(B, R))
+        hs, ls = hs2.reshape(-1), ls2.reshape(-1)
+        # recover validity: valid keys can never equal the sentinel
+        # (their invalid bit is clear); sentinel of sorted block b is
+        # inv_hi[b*F] (inv_hi is F-blocked by batch)
+        sent_h = jnp.repeat(inv_hi[:B * F].reshape(B, F)[:, 0], R)
+        sent_l = jnp.repeat(inv_lo[:B * F].reshape(B, F)[:, 0], R)
+        va = ~((hs == sent_h) & (ls == sent_l))
     else:
-        order = jnp.lexsort((l, h))
-    hs, ls = h[order], l[order]
-    va = valid[order]
+        if single_word:
+            order = jnp.argsort(l)
+        else:
+            order = jnp.lexsort((l, h))
+        hs, ls = h[order], l[order]
+        va = valid[order]
     pad = jnp.zeros(1, bool)
     same = jnp.concatenate([pad, (hs[1:] == hs[:-1])
                             & (ls[1:] == ls[:-1]) & va[:-1]])

@@ -218,10 +218,36 @@ def run_case(test: dict) -> List[Op]:
     return history.snapshot()
 
 
+def snarf_logs(test: dict) -> None:
+    """Download each node's SUT log files into the test's store dir
+    (``core.clj:92-123``); best-effort."""
+    from .. import control
+    from . import store
+
+    db = test.get("db")
+    if not isinstance(db, db_ns.LogFiles) or not test.get("nodes"):
+        return
+
+    def snarf1(test_, node):
+        for remote_path in db.log_files(test_, node):
+            local = store.path_mkdirs(
+                test_, str(node), remote_path.lstrip("/"))
+            try:
+                control.download(remote_path, local)
+            except Exception as e:
+                log.info("couldn't download %s from %s: %s",
+                         remote_path, node, e)
+    try:
+        control.on_nodes(test, snarf1)
+    except Exception as e:
+        log.warning("log snarfing failed: %s", e)
+
+
 def run(test: dict) -> dict:
     """Run a full test; returns the test map with ``history`` and
     ``results`` (``core.clj:324-430``). Lifecycle: os setup → db cycle →
-    clients/nemesis/workers → history → teardown → check."""
+    clients/nemesis/workers → history → log snarfing → teardown →
+    check."""
     from . import store
 
     test = dict(test)
@@ -244,6 +270,9 @@ def run(test: dict) -> dict:
                     history = run_case(test)
                 test["history"] = history
             finally:
+                # snarf before teardown, success or not — teardown can
+                # kill/rotate the very logs needed to debug a failure
+                snarf_logs(test)
                 _on_nodes(test, db.teardown)
         finally:
             _on_nodes(test, os_.teardown)

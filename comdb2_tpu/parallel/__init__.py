@@ -32,12 +32,12 @@ def check_histories_sharded(histories, model, mesh=None, F: int = 256,
     Builds the mesh over all local devices when none is given."""
     from ..checker.batch import check_batch, pack_batch
 
-    mesh = mesh if mesh is not None else make_mesh(axis=axis)
     histories = list(histories)
     n = len(histories)
     if n == 0:
-        empty = np.zeros(0, np.int64)
-        return empty.astype(np.int32), empty, empty.astype(np.int32)
+        return (np.zeros(0, np.int32), np.zeros(0, np.int64),
+                np.zeros(0, np.int32))
+    mesh = mesh if mesh is not None else make_mesh(axis=axis)
     # the batch axis must divide evenly across mesh devices; pad with
     # copies of the first history and slice the results back
     n_dev = mesh.devices.size

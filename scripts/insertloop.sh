@@ -22,10 +22,15 @@ while [ "$RUNS" -eq 0 ] || [ "$n" -lt "$RUNS" ]; do
     n=$((n + 1))
     hist="$(mktemp /tmp/insert-hist-XXXX.edn)"
     echo "=== run $n" >&2
-    "$INSERT" -j "$hist" "$@" || {
+    "$INSERT" -j "$hist" "$@"
+    rc=$?
+    if [ $rc -eq 1 ]; then
         echo "insert driver detected loss; history at $hist" >&2
         exit 1
-    }
+    elif [ $rc -ne 0 ]; then
+        echo "insert driver crashed (rc=$rc)" >&2
+        exit 3
+    fi
     PYTHONPATH="$ROOT" python -m comdb2_tpu.filetest "$hist" \
         --checker set || {
         echo "set checker disagrees; history at $hist" >&2

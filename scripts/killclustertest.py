@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 from comdb2_tpu.harness import killcluster               # noqa: E402
 from comdb2_tpu.workloads.sqlish import MemDB            # noqa: E402

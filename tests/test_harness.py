@@ -117,12 +117,17 @@ def test_invalid_history_detected(tmp_path):
                 return {**op, "type": "ok", "value": 42}
             return {**op, "type": "ok"}
 
+    # at least one read must occur or the lying client goes unnoticed —
+    # seq the guaranteed ops, then pad with a random mix
     t = _base_test(tmp_path, concurrency=2,
                    client=LyingClient(),
-                   generator=G.clients(G.limit(
-                       8, G.mix([{"type": "invoke", "f": "write", "value": 1},
-                                 {"type": "invoke", "f": "read",
-                                  "value": None}]))))
+                   generator=G.clients(G.seq(
+                       [{"type": "invoke", "f": "write", "value": 1},
+                        {"type": "invoke", "f": "read", "value": None},
+                        G.limit(6, G.mix(
+                            [{"type": "invoke", "f": "write", "value": 1},
+                             {"type": "invoke", "f": "read",
+                              "value": None}]))])))
     result = core.run(t)
     assert result["results"]["valid?"] is False
 
@@ -210,6 +215,54 @@ def test_cli_invalid_dominates_unknown(tmp_path, monkeypatch):
     monkeypatch.setattr(core, "run", fake_run)
     rc = cli.single_test_cmd(lambda opts: {}, argv=["--test-count", "2"])
     assert rc == 1
+
+
+def test_snarf_logs_downloads_per_node(tmp_path):
+    from comdb2_tpu.control.remote import RecordingRemote
+    from comdb2_tpu.harness import db as db_ns
+    from comdb2_tpu.harness import generator as G
+    from comdb2_tpu.models import model as M
+
+    class LoggedDB(db_ns.DB, db_ns.LogFiles):
+        def log_files(self, test, node):
+            return [f"/var/log/sut/{node}.log"]
+
+    rec = RecordingRemote()
+    state = fake.Atom()
+    t = fake.noop_test()
+    t.update({"nodes": ["n1", "n2"], "concurrency": 2,
+              "name": "snarf", "store-root": str(tmp_path / "store"),
+              "remote": rec, "db": LoggedDB(),
+              "client": fake.atom_client(state),
+              "model": M.cas_register(),
+              "generator": G.clients(G.limit(4, G.cas_gen))})
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
+    assert sorted(rec.downloads) == [
+        ("n1", "/var/log/sut/n1.log",
+         store.path(result, "n1", "var/log/sut/n1.log")),
+        ("n2", "/var/log/sut/n2.log",
+         store.path(result, "n2", "var/log/sut/n2.log"))]
+
+
+def test_independent_checker_writes_per_key_artifacts(tmp_path):
+    from comdb2_tpu.checker import checkers as C
+    from comdb2_tpu.checker import independent as I
+    from comdb2_tpu.models import model as M
+    from comdb2_tpu.ops.kv import tuple_
+    from comdb2_tpu.ops.op import invoke, ok
+
+    h = []
+    for k in range(3):
+        h += [invoke(k, "write", tuple_(k, 1)),
+              ok(k, "write", tuple_(k, 1))]
+    c = I.checker(C.Linearizable())
+    test = {"name": "ind", "dir": str(tmp_path)}
+    r = c.check(test, M.register(), h)
+    assert r["valid?"] is True
+    for k in range(3):
+        assert (tmp_path / "independent" / str(k) / "results.edn").exists()
+        assert (tmp_path / "independent" / str(k) / "history.edn").exists()
 
 
 def test_on_nodes_parallel_and_errors():
