@@ -25,6 +25,20 @@ BASELINE_OPS_S = N_OPS / 3600.0
 
 
 def main() -> None:
+    try:
+        _run_bench()
+    except Exception as e:          # one JSON line, even on failure
+        print(json.dumps({
+            "metric": "linear_check_ops_per_s_50k",
+            "value": 0.0,
+            "unit": "ops/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        raise SystemExit(1)
+
+
+def _run_bench() -> None:
     import jax
     from comdb2_tpu.checker import linear_jax as LJ
     from comdb2_tpu.models.memo import memo as make_memo

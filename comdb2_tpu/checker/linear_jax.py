@@ -58,10 +58,13 @@ UNKNOWN = 2    # frontier overflow
 
 
 class StepStream(NamedTuple):
-    """Host-precompiled per-op step metadata (see :func:`make_stream`)."""
-    kind: jnp.ndarray   # int32[n]
-    proc: jnp.ndarray   # int32[n]
-    tr: jnp.ndarray     # int32[n]
+    """Host-precompiled per-op step metadata (see :func:`make_stream`).
+    Kept as host numpy arrays — jit transfers them once at check time;
+    eagerly device_putting here costs a tunnel round-trip per array
+    (and another one back for batch packing)."""
+    kind: np.ndarray   # int32[n]
+    proc: np.ndarray   # int32[n]
+    tr: np.ndarray     # int32[n]
 
 
 def make_stream(packed, n_pad: Optional[int] = None) -> StepStream:
@@ -83,7 +86,7 @@ def make_stream(packed, n_pad: Optional[int] = None) -> StepStream:
         elif t == OK:
             kind[i] = K_OK
             proc[i] = packed.process[i]
-    return StepStream(jnp.asarray(kind), jnp.asarray(proc), jnp.asarray(tr))
+    return StepStream(kind, proc, tr)
 
 
 def pad_succ(succ: np.ndarray, s_pad: Optional[int] = None,

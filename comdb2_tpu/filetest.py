@@ -17,7 +17,7 @@ from typing import List, Optional
 from .checker import analysis
 from .checker.checkers import set_checker
 from .models.model import MODELS
-from .ops.history import parse_history
+from .ops.native_loader import parse_history_fast as parse_history
 
 
 def main(argv: Optional[List[str]] = None) -> int:

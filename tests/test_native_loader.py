@@ -1,0 +1,83 @@
+"""Native EDN loader: parity with the Python reader + fallback."""
+
+import pytest
+
+from comdb2_tpu.ops import history as H
+from comdb2_tpu.ops import native_loader as NL
+
+DRIVER_EDN = """[
+{:type :invoke :f :read :value nil :process 0 :time 10}
+{:type :ok :f :read :value 3 :process 0 :uid 7 :time 20}
+{:type :invoke :f :cas :value [2 4] :process 1 :time 30}
+{:type :fail :f :cas :value [2 4] :process 1 :time 40}
+{:type :invoke :f :write :value [1 [0 3]] :process 2 :time 50}
+{:type :info :f :write :value [1 [0 3]] :process 2 :time 60}
+{:type :invoke :f :add :value [5 nil] :process 3 :time 70}
+]
+"""
+
+requires_native = pytest.mark.skipif(not NL.native_available(),
+                                     reason="libct_sut.so not built")
+
+
+@requires_native
+def test_native_matches_python_reader():
+    fast = NL.parse_history_fast(DRIVER_EDN)
+    slow = H.parse_history(DRIVER_EDN)
+    assert len(fast) == len(slow) == 7
+    for a, b in zip(fast, slow):
+        assert (a.process, a.type, a.f, a.value, a.time) == \
+               (b.process, b.type, b.f, b.value, b.time)
+    assert fast[4].value == (1, (0, 3))
+    assert fast[6].value == (5, None)
+
+
+@requires_native
+def test_native_falls_back_outside_subset():
+    # string values are valid EDN but outside the fast subset
+    edn = '{:type :invoke :f :read :value "weird" :process 0 :time 1}'
+    ops = NL.parse_history_fast(edn)
+    assert len(ops) == 1
+    assert ops[0].value == "weird"      # python reader handled it
+
+
+@requires_native
+def test_native_edge_values_match_python():
+    """Shapes that once diverged: inner-vector-not-last, out-of-range
+    ints, and INT64_MIN (the nil sentinel) must fall back, never skew."""
+    cases = [
+        "{:type :invoke :f :x :value [1 [2 3] 4] :process 0 :time 1}",
+        "{:type :invoke :f :x :value 9223372036854775808 "
+        ":process 0 :time 1}",
+        "{:type :invoke :f :x :value -9223372036854775808 "
+        ":process 0 :time 1}",
+    ]
+    for edn in cases:
+        fast = NL.parse_history_fast(edn)
+        slow = H.parse_history(edn)
+        assert [(o.value,) for o in fast] == [(o.value,) for o in slow], edn
+
+
+@requires_native
+def test_native_rejects_malformed_gracefully():
+    with pytest.raises(Exception):
+        NL.parse_history_fast("{:type :invoke :f }")
+
+
+@requires_native
+def test_native_loader_on_driver_output(tmp_path):
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(root, "native", "build", "ct_register")
+    if not os.path.exists(binary):
+        pytest.skip("native drivers not built")
+    out = tmp_path / "h.edn"
+    subprocess.run([binary, "-T", "3", "-i", "50", "-r", "30",
+                    "-j", str(out), "-s", "2"], check=True,
+                   capture_output=True)
+    fast = NL.parse_history_fast(out.read_text())
+    slow = H.parse_history(out.read_text())
+    assert [(o.process, o.type, o.f, o.value) for o in fast] == \
+           [(o.process, o.type, o.f, o.value) for o in slow]
