@@ -1,0 +1,143 @@
+"""TCP SUT client — drive the native ``sut_server`` over its line
+protocol.
+
+This closes the distributed loop end to end: the harness's workers talk
+to a real out-of-process SUT over sockets, socket timeouts surface as
+indeterminate (``info``) completions exactly like the reference's
+JDBC timeouts, and process faults (SIGSTOP on the server) produce the
+hung-op behavior the checker must reason about.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional, Tuple
+
+from ..harness import client as client_ns
+from ..ops.kv import tuple_
+
+
+class SutConnection:
+    """One line-protocol connection with a hard timeout."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 1.0):
+        self.addr = (host, port)
+        self.timeout_s = timeout_s
+        self.sock: Optional[socket.socket] = None
+        self.rfile = None
+
+    def connect(self) -> None:
+        self.close()
+        s = socket.create_connection(self.addr, timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = s
+        self.rfile = s.makefile("r")
+
+    def close(self) -> None:
+        try:
+            if self.rfile is not None:
+                self.rfile.close()
+            if self.sock is not None:
+                self.sock.close()
+        except OSError:
+            pass
+        self.sock = None
+        self.rfile = None
+
+    def request(self, line: str) -> str:
+        """Send one request line; returns the reply line. Raises
+        ``TimeoutError`` when the server doesn't answer in time (the
+        op's outcome is then unknown — it may have applied)."""
+        if self.sock is None:
+            self.connect()
+        try:
+            self.sock.sendall((line + "\n").encode())
+            reply = self.rfile.readline()
+        except socket.timeout as e:
+            self.close()
+            raise TimeoutError(f"SUT timeout on {line!r}") from e
+        except OSError as e:
+            self.close()
+            raise TimeoutError(f"SUT connection lost on {line!r}") from e
+        if not reply:
+            self.close()
+            raise TimeoutError(f"SUT closed connection on {line!r}")
+        return reply.strip()
+
+
+class TcpRegisterClient(client_ns.Client):
+    """read/write/cas against ``sut_server``; values are keyed
+    ``(k, v)`` tuples like the comdb2 register client's. A timeout
+    yields an ``info`` completion (indeterminate — the worker retires
+    the process)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7777,
+                 timeout_s: float = 1.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.conn: Optional[SutConnection] = None
+
+    def setup(self, test, node):
+        c = TcpRegisterClient(self.host, self.port, self.timeout_s)
+        c.conn = SutConnection(self.host, self.port, self.timeout_s)
+        c.conn.connect()
+        return c
+
+    def teardown(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def invoke(self, test, op):
+        f = op["f"]
+        k, v = op["value"] if op["value"] is not None else (1, None)
+        try:
+            if f == "read":
+                reply = self.conn.request("R")
+                if reply == "NIL":
+                    return {**op, "type": "ok", "value": tuple_(k, None)}
+                if reply.startswith("V "):
+                    return {**op, "type": "ok",
+                            "value": tuple_(k, int(reply[2:]))}
+                return {**op, "type": "fail"}
+            if f == "write":
+                reply = self.conn.request(f"W {v}")
+            elif f == "cas":
+                a, b = v
+                reply = self.conn.request(f"C {a} {b}")
+            else:
+                raise ValueError(f"unknown f {f!r}")
+            if reply == "OK":
+                return {**op, "type": "ok"}
+            if reply == "FAIL":
+                return {**op, "type": "fail"}
+            return {**op, "type": "info", "error": reply}
+        except TimeoutError as e:
+            return {**op, "type": "info", "error": str(e)}
+
+
+def spawn_server(binary: str, port: int, *flags: str,
+                 wait_s: float = 5.0) -> "subprocess.Popen":
+    """Start a local sut_server and wait until it answers PING."""
+    import subprocess
+    import time
+
+    proc = subprocess.Popen([binary, "-p", str(port), *flags],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + wait_s
+    conn = SutConnection("127.0.0.1", port, timeout_s=0.3)
+    while time.monotonic() < deadline:
+        rc = proc.poll()
+        if rc is not None:      # died at startup (bad port/flags)
+            raise RuntimeError(
+                f"sut_server on port {port} exited rc={rc} at startup")
+        try:
+            conn.connect()
+            if conn.request("P") == "PONG":
+                conn.close()
+                return proc
+        except (OSError, TimeoutError):
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError(f"sut_server on port {port} never became ready")

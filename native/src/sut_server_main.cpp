@@ -1,0 +1,154 @@
+/* sut_server — a network-reachable SUT for end-to-end harness runs.
+ *
+ * Wraps the in-memory backend (sut_mem.cpp) behind a line protocol on
+ * TCP, so the Python harness (or the native drivers) can exercise the
+ * full distributed loop: sockets, timeouts, process faults (SIGSTOP →
+ * client timeouts → indeterminate ops), crash-restart.
+ *
+ * Protocol (one request per line, one reply per line):
+ *   R            -> "V <int>" | "NIL"        (register read)
+ *   W <v>        -> "OK"                     (register write)
+ *   C <a> <b>    -> "OK" | "FAIL"            (cas expected new)
+ *   A <v>        -> "OK"                     (set add)
+ *   S            -> "V <v1> <v2> ..."        (set read)
+ *   P            -> "PONG"                   (health)
+ * Flags: -p port (default 7777), -F flaky, -B buggy, -s seed.
+ */
+#include "comdb2_tpu/sut.h"
+#include "comdb2_tpu/testutil.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void serve_conn(int fd, uint32_t flags, unsigned seed) {
+    sut_handle *h = sut_open(nullptr, flags, seed);
+    FILE *in = fdopen(fd, "r");
+    if (in == nullptr) {
+        close(fd);
+        sut_close(h);
+        return;
+    }
+    char line[256];
+    std::string out;
+    while (fgets(line, sizeof line, in) != nullptr) {
+        out.clear();
+        char cmd = line[0];
+        if (cmd == 'P') {
+            out = "PONG\n";
+        } else if (cmd == 'R') {
+            int v = 0, found = 0;
+            int rc = sut_reg_read(h, &v, &found);
+            if (rc == SUT_OK)
+                out = found ? ("V " + std::to_string(v) + "\n") : "NIL\n";
+            else
+                out = "FAIL\n";
+        } else if (cmd == 'W') {
+            int v = atoi(line + 1);
+            int rc = sut_reg_write(h, v);
+            out = rc == SUT_OK ? "OK\n"
+                : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
+        } else if (cmd == 'C') {
+            int a = 0, b = 0;
+            if (sscanf(line + 1, "%d %d", &a, &b) != 2) {
+                out = "ERR\n";
+            } else {
+                int rc = sut_reg_cas(h, a, b);
+                out = rc == SUT_OK ? "OK\n"
+                    : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
+            }
+        } else if (cmd == 'A') {
+            long long v = atoll(line + 1);
+            int rc = sut_set_add(h, v);
+            out = rc == SUT_OK ? "OK\n"
+                : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
+        } else if (cmd == 'S') {
+            long long *vals = nullptr;
+            size_t n = 0;
+            if (sut_set_read(h, &vals, &n) == SUT_OK) {
+                out = "V";
+                for (size_t i = 0; i < n; i++)
+                    out += " " + std::to_string(vals[i]);
+                out += "\n";
+                free(vals);
+            } else {
+                out = "FAIL\n";
+            }
+        } else {
+            out = "ERR\n";
+        }
+        /* loop: a short write (signal interruption, full send buffer
+         * on a large set-read reply) would desync the line protocol */
+        size_t off = 0;
+        bool werr = false;
+        while (off < out.size()) {
+            ssize_t w = write(fd, out.c_str() + off, out.size() - off);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                werr = true;
+                break;
+            }
+            off += (size_t)w;
+        }
+        if (werr) break;
+    }
+    fclose(in);   /* closes fd */
+    sut_close(h);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    int port = 7777;
+    uint32_t flags = SUT_F_NONE;
+    unsigned seed = 0;
+    int c;
+    while ((c = getopt(argc, argv, "p:FBs:h")) != -1) {
+        switch (c) {
+        case 'p': port = atoi(optarg); break;
+        case 'F': flags |= SUT_F_FLAKY; break;
+        case 'B': flags |= SUT_F_BUGGY; break;
+        case 's': seed = (unsigned)atol(optarg); break;
+        default:
+            fprintf(stderr, "usage: %s [-p port] [-F] [-B] [-s seed]\n",
+                    argv[0]);
+            return 2;
+        }
+    }
+    signal(SIGPIPE, SIG_IGN);
+
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(srv, (sockaddr *)&addr, sizeof addr) != 0 ||
+        listen(srv, 64) != 0) {
+        perror("bind/listen");
+        return 2;
+    }
+    fprintf(stderr, "sut_server listening on 127.0.0.1:%d\n", port);
+
+    unsigned conn_seed = seed;
+    for (;;) {
+        int fd = accept(srv, nullptr, nullptr);
+        if (fd < 0) continue;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        std::thread(serve_conn, fd, flags, ++conn_seed).detach();
+    }
+}

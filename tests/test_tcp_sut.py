@@ -1,0 +1,109 @@
+"""End-to-end distributed loop: harness → TCP → native sut_server,
+with SIGSTOP faults producing indeterminate ops."""
+
+import os
+import signal
+import socket
+import subprocess
+import time
+
+import pytest
+
+from comdb2_tpu.checker import checkers as C
+from comdb2_tpu.checker import independent as I
+from comdb2_tpu.harness import core, fake
+from comdb2_tpu.harness import generator as G
+from comdb2_tpu.models import model as M
+from comdb2_tpu.workloads import comdb2 as W
+from comdb2_tpu.workloads.tcp import TcpRegisterClient, spawn_server
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(ROOT, "native", "build", "sut_server")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(BINARY),
+                                reason="sut_server not built")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def server():
+    port = _free_port()
+    proc = spawn_server(BINARY, port)
+    yield port, proc
+    proc.kill()
+    proc.wait()
+
+
+def _tcp_test(tmp_path, port, **kw):
+    t = fake.noop_test()
+    t.update({
+        "nodes": [], "concurrency": 5, "name": "tcp-register",
+        "store-root": str(tmp_path / "store"),
+        "client": TcpRegisterClient(port=port, timeout_s=0.5),
+        "model": M.cas_register(),
+        "generator": G.clients(G.limit(
+            100, G.mix([W.r, W.w, W.cas]))),
+        # host engine: this is a harness E2E test; device compiles for
+        # the odd shapes here would dominate suite time
+        "checker": I.checker(C.Linearizable(backend="host")),
+    })
+    t.update(kw)
+    return t
+
+
+def test_tcp_register_run_valid(tmp_path, server):
+    port, _proc = server
+    result = core.run(_tcp_test(tmp_path, port))
+    assert result["results"]["valid?"] is True, result["results"]
+    oks = [op for op in result["history"] if op.type == "ok"]
+    assert len(oks) >= 50
+
+
+def test_tcp_register_sigstop_yields_info_ops(tmp_path, server):
+    """SIGSTOP the server mid-run: requests time out, workers record
+    info ops and retire processes, and the history stays linearizable
+    once the server resumes."""
+    port, proc = server
+
+    class Stopper(fake.client_ns.Client):
+        def invoke(self, test, op):
+            if op["f"] == "start":
+                proc.send_signal(signal.SIGSTOP)
+            else:
+                proc.send_signal(signal.SIGCONT)
+            return dict(op)
+
+    t = _tcp_test(
+        tmp_path, port,
+        nemesis=Stopper(),
+        generator=G.nemesis(
+            G.seq([G.sleep(0.2), {"type": "info", "f": "start"},
+                   G.sleep(1.2), {"type": "info", "f": "stop"}]),
+            G.stagger(0.01, G.limit(120, G.mix([W.r, W.w, W.cas])))))
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+    infos = [op for op in result["history"]
+             if op.type == "info" and op.process != "nemesis"]
+    assert infos, "SIGSTOP window should have produced timeouts"
+
+
+def test_tcp_buggy_server_detected(tmp_path):
+    """The negative control over the wire: a buggy server must be
+    flagged invalid by the checker."""
+    port = _free_port()
+    proc = spawn_server(BINARY, port, "-B", "-s", "11")
+    try:
+        t = _tcp_test(tmp_path, port)
+        t["generator"] = G.clients(G.limit(150, G.mix([W.r, W.w, W.cas])))
+        result = core.run(t)
+        assert result["results"]["valid?"] is False, result["results"]
+    finally:
+        proc.kill()
+        proc.wait()
