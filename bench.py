@@ -54,7 +54,9 @@ def _run_bench() -> None:
     mm = make_memo(cas_register(), packed)
     succ = LJ.pad_succ(mm.succ, 64, 64)
     segs = LJ.make_segments(packed)
-    F, P = 128, 8
+    # the production even-bucketed slot width (see linear._analyze_device)
+    # — bench the shape the checker actually runs
+    F, P = 128, N_PROCS + (N_PROCS & 1)
 
     def run():
         status, fail_seg, n = LJ.check_device_seg(

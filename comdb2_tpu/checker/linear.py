@@ -131,7 +131,12 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     info: dict = {"backend": "device", "n_states": mm.n_states,
                   "n_transitions": mm.n_transitions}
     sizes = {"n_states": mm.n_states, "n_transitions": mm.n_transitions}
-    P2 = _next_pow2(P, 2)
+    # bucket the slot axis to the next even value, not pow2: candidate
+    # rows scale with P, so pow2 padding costs up to ~25% extra work
+    # per closure iteration (measured 9.5k -> 11.4k ops/s on the bench
+    # shape); even-bucketing keeps recompiles bounded
+    P2 = P + (P & 1)
+    P2 = max(P2, 2)
     for F in capacities:
         if progress is None:
             status, fail_seg, n_final = LJ.check_device_seg(

@@ -184,6 +184,10 @@ def _dedup_compact(states, slots, valid, F, state_bits=None,
                                 & va[:-1]])
     keep = va & ~same
     n = jnp.sum(keep)
+    # measured on v5e: a second small argsort beats cumsum+scatter
+    # compaction here (~9.5k vs ~7.3k ops/s on the 50k bench); the
+    # flat-batch engines use scatter because their row counts are
+    # larger and block-structured
     order2 = jnp.argsort(~keep, stable=True)[:F]
     sel = order[order2]
     return states[sel], slots[sel], keep[order2], n, n > F

@@ -40,6 +40,7 @@ Shared &shared() {
 struct sut_handle {
     uint32_t flags;
     std::mt19937 rng;
+    unsigned bug_n = 0;
 
     explicit sut_handle(uint32_t fl, unsigned seed) : flags(fl), rng(seed) {}
 
@@ -51,8 +52,11 @@ struct sut_handle {
     bool flaky_unknown() {
         return (flags & SUT_F_FLAKY) && rng() % 8 == 0;
     }
+    /* deterministic: every 4th roll fires, so a buggy backend reliably
+     * misbehaves within a handful of ops (the negative controls must
+     * not flake) */
     bool bug_roll() {
-        return (flags & SUT_F_BUGGY) && rng() % 4 == 0;
+        return (flags & SUT_F_BUGGY) && (bug_n++ % 4 == 3);
     }
 };
 

@@ -96,14 +96,21 @@ def test_tcp_register_sigstop_yields_info_ops(tmp_path, server):
 
 def test_tcp_buggy_server_detected(tmp_path):
     """The negative control over the wire: a buggy server must be
-    flagged invalid by the checker."""
-    port = _free_port()
-    proc = spawn_server(BINARY, port, "-B", "-s", "11")
-    try:
-        t = _tcp_test(tmp_path, port)
-        t["generator"] = G.clients(G.limit(150, G.mix([W.r, W.w, W.cas])))
-        result = core.run(t)
-        assert result["results"]["valid?"] is False, result["results"]
-    finally:
-        proc.kill()
-        proc.wait()
+    flagged invalid by the checker. The injected bug (dropped writes /
+    stale reads) fires probabilistically, so give it a few rounds —
+    any single round flagging invalid proves the pipeline."""
+    for attempt, seed in enumerate(("11", "23", "47")):
+        port = _free_port()
+        proc = spawn_server(BINARY, port, "-B", "-s", seed)
+        try:
+            t = _tcp_test(tmp_path, port, name=f"tcp-buggy-{attempt}")
+            t["generator"] = G.clients(
+                G.limit(250, G.mix([W.r, W.r, W.w, W.cas])))
+            result = core.run(t)
+            if result["results"]["valid?"] is False:
+                return
+        finally:
+            proc.kill()
+            proc.wait()
+    raise AssertionError(
+        "buggy server never produced a detectable violation in 3 runs")
