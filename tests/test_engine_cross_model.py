@@ -1,0 +1,171 @@
+"""Cross-model engine agreement: device, host, WGL, and brute engines
+must agree on models beyond cas-register (mutex, multi-register,
+unordered queue)."""
+
+import random
+
+import pytest
+
+from comdb2_tpu.checker import analysis, brute, linear_host, wgl
+from comdb2_tpu.models import model as M
+from comdb2_tpu.models.memo import memo as make_memo
+from comdb2_tpu.ops.op import invoke, ok, fail, info
+from comdb2_tpu.ops.packed import pack_history
+
+
+def _mutex_history(rng, n_procs, n_events):
+    """Concurrent acquire/release attempts against a real lock."""
+    locked_by = None
+    procs = {i: None for i in range(n_procs)}   # in-flight op
+    h = []
+    while len(h) < n_events:
+        p = rng.randrange(n_procs)
+        if procs[p] is None:
+            f = rng.choice(["acquire", "release"])
+            procs[p] = f
+            h.append(invoke(p, f, None))
+        else:
+            f = procs[p]
+            procs[p] = None
+            if f == "acquire":
+                if locked_by is None:
+                    locked_by = p
+                    h.append(ok(p, f, None))
+                else:
+                    h.append(fail(p, f, None))
+            else:
+                if locked_by == p:
+                    locked_by = None
+                    h.append(ok(p, f, None))
+                else:
+                    h.append(fail(p, f, None))
+    return h
+
+
+def _queue_history(rng, n_procs, n_events):
+    """enqueue/dequeue against a real unordered queue."""
+    import collections
+
+    q = collections.deque()
+    procs = {i: None for i in range(n_procs)}
+    counter = iter(range(10**6))
+    h = []
+    while len(h) < n_events:
+        p = rng.randrange(n_procs)
+        if procs[p] is None:
+            if rng.random() < 0.5:
+                v = next(counter)
+                procs[p] = ("enqueue", v)
+                h.append(invoke(p, "enqueue", v))
+            else:
+                procs[p] = ("dequeue", None)
+                h.append(invoke(p, "dequeue", None))
+        else:
+            f, v = procs[p]
+            procs[p] = None
+            if f == "enqueue":
+                q.append(v)
+                h.append(ok(p, f, v))
+            else:
+                if q:
+                    got = q.popleft() if rng.random() < 0.5 else q.pop()
+                    h.append(ok(p, f, got))
+                else:
+                    h.append(fail(p, f, None))
+    return h
+
+
+def _multireg_history(rng, n_procs, n_events):
+    state = {}
+    procs = {i: None for i in range(n_procs)}
+    h = []
+    keys = ["x", "y"]
+    while len(h) < n_events:
+        p = rng.randrange(n_procs)
+        if procs[p] is None:
+            micro = []
+            for _ in range(rng.randint(1, 2)):
+                k = rng.choice(keys)
+                if rng.random() < 0.5:
+                    micro.append(("write", k, rng.randrange(3)))
+                else:
+                    micro.append(("read", k, None))
+            procs[p] = micro
+            h.append(invoke(p, "txn", tuple(tuple(m) for m in micro)))
+        else:
+            micro = procs[p]
+            procs[p] = None
+            filled = []
+            for mf, k, v in micro:
+                if mf == "write":
+                    state[k] = v
+                    filled.append(("write", k, v))
+                else:
+                    filled.append(("read", k, state.get(k)))
+            h.append(ok(p, "txn", tuple(filled)))
+    return h
+
+
+CASES = [
+    ("mutex", M.mutex, _mutex_history),
+    ("unordered-queue", M.unordered_queue, _queue_history),
+    ("multi-register", M.multi_register, _multireg_history),
+]
+
+
+@pytest.mark.parametrize("name,mk_model,mk_hist",
+                         CASES, ids=[c[0] for c in CASES])
+def test_engines_agree_on_valid_histories(name, mk_model, mk_hist):
+    for seed in range(6):
+        rng = random.Random(9_000 + seed)
+        h = mk_hist(rng, 3, 24)
+        model = mk_model()
+        a_dev = analysis(model, h, backend="device")
+        a_host = analysis(model, h, backend="host")
+        r_wgl = wgl.analysis(model, h)
+        assert a_host.valid is True, (name, seed, a_host.to_map())
+        assert a_dev.valid is True, (name, seed)
+        assert r_wgl["valid?"] is True, (name, seed)
+
+
+@pytest.mark.parametrize("name,mk_model,mk_hist",
+                         CASES, ids=[c[0] for c in CASES])
+def test_engines_agree_on_corrupted_histories(name, mk_model, mk_hist):
+    """Corrupt completions; all engines must render the same verdict
+    (brute is the oracle on these tiny histories)."""
+    corrupted = 0
+    for seed in range(8):
+        rng = random.Random(17_000 + seed)
+        h = mk_hist(rng, 3, 14)
+        # corruption: flip a fail->ok when one exists, else falsify an
+        # ok completion's observed value (multi-register histories have
+        # no fails — a read result is altered instead)
+        fails = [i for i, op in enumerate(h) if op.type == "fail"]
+        oks = [i for i, op in enumerate(h)
+               if op.type == "ok" and op.value is not None]
+        if fails:
+            i = rng.choice(fails)
+            h[i] = h[i].with_(type="ok")
+            corrupted += 1
+        elif oks:
+            i = rng.choice(oks)
+            v = h[i].value
+            if isinstance(v, tuple) and v and isinstance(v[0], tuple):
+                # txn micro-ops: falsify the first micro-op's value
+                mf, k, mv = v[0]
+                bad = (mf, k, (mv or 0) + 7)
+                h[i] = h[i].with_(value=(bad,) + v[1:])
+            else:
+                h[i] = h[i].with_(value=999)
+            corrupted += 1
+        model = mk_model()
+        want = brute.brute_valid(model, h)
+        a_dev = analysis(model, h, backend="device",
+                         capacities=(1024,))
+        a_host = analysis(model, h, backend="host")
+        r_wgl = wgl.analysis(model, h)
+        assert a_host.valid == want, (name, seed)
+        assert r_wgl["valid?"] == want, (name, seed)
+        if a_dev.valid != "unknown":
+            assert a_dev.valid == want, (name, seed)
+    assert corrupted >= 6, "corruption path barely exercised"

@@ -96,12 +96,13 @@ def test_tcp_register_sigstop_yields_info_ops(tmp_path, server):
 
 def test_tcp_buggy_server_detected(tmp_path):
     """The negative control over the wire: a buggy server must be
-    flagged invalid by the checker. The injected bug (dropped writes /
-    stale reads) fires probabilistically, so give it a few rounds —
-    any single round flagging invalid proves the pipeline."""
-    for attempt, seed in enumerate(("11", "23", "47")):
+    flagged invalid by the checker. The bug fires deterministically
+    (every 4th roll per connection), but *detection* depends on op
+    interleaving and which ops land on which connection — retry a few
+    rounds so thread-timing variance can't flake the test."""
+    for attempt in range(3):
         port = _free_port()
-        proc = spawn_server(BINARY, port, "-B", "-s", seed)
+        proc = spawn_server(BINARY, port, "-B")
         try:
             t = _tcp_test(tmp_path, port, name=f"tcp-buggy-{attempt}")
             t["generator"] = G.clients(
