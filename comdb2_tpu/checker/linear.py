@@ -52,7 +52,7 @@ class Analysis:
 def analysis(model: Model,
              history: Union[Sequence[Op], PackedHistory],
              backend: str = "auto",
-             capacities: Sequence[int] = (64, 1024, 8192, 65536),
+             capacities: Sequence[int] = (256, 1024, 8192, 65536),
              host_threshold: int = 128,
              max_states: int = 1 << 20,
              max_host_configs: int = 1 << 22,
@@ -137,11 +137,16 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     # shape); even-bucketing keeps recompiles bounded
     P2 = P + (P & 1)
     P2 = max(P2, 2)
+    # the adaptive engine's small tier: most segments' closed frontiers
+    # are tiny (p50 ~ 8 configs on the register bench), so each segment
+    # first runs at Fs and escalates to F per-segment on overflow (the
+    # engine degrades to big-only when F is too small for the tier)
+    Fs = 32
     for F in capacities:
         if progress is None:
-            status, fail_seg, n_final = LJ.check_device_seg(
+            status, fail_seg, n_final = LJ.check_device_seg2(
                 succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
-                segs.depth, F=F, P=P2, **sizes)
+                segs.depth, F=F, Fs=Fs, P=P2, **sizes)
         else:
             # chunked: report between device calls at ~interval cadence
             S = segs.ok_proc.shape[0]
@@ -158,9 +163,9 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
                 op_ = np.pad(segs.ok_proc[done:end], (0, pad),
                              constant_values=-1)
                 dp = np.pad(segs.depth[done:end], (0, pad))
-                carry = LJ.check_device_seg_chunk(
-                    succ, ip, it, op_, dp, done, carry, F=F, P=P2,
-                    **sizes)
+                carry = LJ.check_device_seg2_chunk(
+                    succ, ip, it, op_, dp, done, carry, F=F, Fs=Fs,
+                    P=P2, **sizes)
                 done = end
                 if int(carry[4]) != LJ.VALID:
                     break

@@ -19,3 +19,15 @@ def ensure_backend() -> str:
         jax.config.update("jax_platforms", "cpu")
         jax.devices()
     return jax.default_backend()
+
+
+def enable_compile_cache(path: str = "/tmp/jax-cache-comdb2tpu",
+                         min_compile_secs: float = 0.5) -> None:
+    """Turn on the persistent XLA compile cache. Must go through
+    jax.config (not env vars): the ambient startup hook may have
+    imported jax already, and jax reads the env only at import."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)

@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Compare single-history device engines on the bench shape (real TPU).
+
+Usage: PYTHONPATH=$AXON_SITE:. python scripts/perf_compare.py [n_ops]
+Reports ops/s for each engine on the 50k-op register history; asserts
+every engine reaches the known-correct verdict.
+"""
+from __future__ import annotations
+
+import random
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from comdb2_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+
+    from comdb2_tpu.checker import linear_jax as LJ
+    from comdb2_tpu.models.memo import memo as make_memo
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.packed import pack_history
+    from comdb2_tpu.ops.synth import register_history
+
+    n_ops = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    rng = random.Random(42)
+    history = register_history(rng, n_procs=5, n_events=2 * n_ops,
+                               values=5, p_info=0.0)
+    packed = pack_history(history)
+    n_inv = sum(1 for op in history if op.type == "invoke")
+    mm = make_memo(cas_register(), packed)
+    succ = LJ.pad_succ(mm.succ, 64, 64)
+    segs = LJ.make_segments(packed)
+    S, K = segs.inv_proc.shape
+    F, P = 128, 6
+    sizes = dict(n_states=mm.n_states, n_transitions=mm.n_transitions)
+
+    def bench(name, fn, check):
+        st = fn()
+        jax.block_until_ready(st)
+        assert check(st), f"{name} misjudged: {st}"
+        ts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            st = fn()
+            jax.block_until_ready(st)
+            ts.append(time.perf_counter() - t0)
+        dt = min(ts)
+        print(f"{name:24s} {n_inv / dt:10.1f} ops/s   ({dt:.3f} s)",
+              flush=True)
+
+    def single(st):
+        return int(st) == LJ.VALID
+
+    def lane0(st):
+        return int(st[0]) == LJ.VALID
+
+    bench("seg", lambda: LJ.check_device_seg(
+        succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+        F=F, P=P, **sizes)[0], single)
+
+    for fs in (16, 32, 48):
+        bench(f"seg2 Fs={fs}", lambda fs=fs: LJ.check_device_seg2(
+            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+            F=F, Fs=fs, P=P, **sizes)[0], single)
+
+    # B=1 flat engines: seg arrays reshaped to (S, 1, K) / (S, 1)
+    ip = segs.inv_proc.reshape(S, 1, K)
+    it = segs.inv_tr.reshape(S, 1, K)
+    op = segs.ok_proc.reshape(S, 1)
+    bench("keys B=1", lambda: LJ.check_device_keys(
+        succ, ip, it, op, segs.depth, B=1, F=F, P=P, **sizes)[0], lane0)
+
+    bench("flat B=1", lambda: LJ.check_device_flat(
+        succ, ip, it, op, segs.depth, B=1, F=F, P=P, **sizes)[0], lane0)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,13 +22,18 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # persistent compile cache: the frontier-search programs are expensive to
-# compile and shape-stable across runs
+# compile and shape-stable across runs. Env vars alone are NOT enough —
+# the ambient startup hook imports jax before this file runs and jax
+# reads them at import — so go through jax.config (same reason
+# jax.config.update is used for the platform below).
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       "/tmp/jax-cache-comdb2tpu")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import jax  # noqa: E402
 
+from comdb2_tpu.utils.platform import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", (
     f"tests must run on the CPU mesh, got {jax.default_backend()!r} — "

@@ -71,6 +71,58 @@ def test_device_matches_host_random(seed):
         assert fail_at == hr.op_index
 
 
+@pytest.mark.parametrize("packed_path", [False, True])
+def test_seg2_adaptive_matches_host_fuzz(packed_path):
+    """The two-tier engine (small closure + per-segment escalation) must
+    agree with the host reference on verdicts AND fail indices. Shapes
+    are bucketed so the whole fuzz shares a few compiled programs.
+
+    packed_path=True sizes the state space so the two-word packed dedup
+    (incl. the returned-first bit at hi bit 29) is what runs; False
+    forces the full-lexsort fallback (P=8 slots never fit the budget)."""
+    if packed_path:
+        P, sizes = 4, dict(n_states=16, n_transitions=16)
+        assert LJ.pack_bits(16, 16, P)[2]     # the budget must fit
+    else:
+        P, sizes = 8, dict(n_states=64, n_transitions=64)
+        assert not LJ.pack_bits(64, 64, P)[2]
+    hits = 0
+    for seed in range(88_000, 88_120):
+        rng = random.Random(seed)
+        h = histgen.register_history(
+            rng, n_procs=rng.randint(2, 3 if packed_path else 5),
+            n_events=rng.randint(6, 40),
+            p_info=0.05 if packed_path else 0.15,
+            values=2 if packed_path else 5)
+        if rng.random() < 0.5:
+            h = histgen.mutate(rng, h)
+        packed = pack_history(h)
+        mm = make_memo(M.cas_register(), packed)
+        if (len(packed.process_table) > P
+                or mm.n_states > sizes["n_states"]
+                or mm.n_transitions > sizes["n_transitions"]):
+            continue
+        segs = LJ.make_segments(packed, s_pad=32, k_pad=8)
+        if segs.inv_proc.shape != (32, 8):
+            continue
+        hr = linear_host.check(mm, packed, max_configs=1 << 18)
+        # sizes are bucketed to keep one jit signature; padding ids are
+        # unreachable so semantics are unchanged
+        status, fa, _ = LJ.check_device_seg2(
+            LJ.pad_succ(mm.succ, sizes["n_states"],
+                        sizes["n_transitions"]),
+            segs.inv_proc, segs.inv_tr,
+            segs.ok_proc, segs.depth, F=64, Fs=8, P=P, **sizes)
+        if int(status) == LJ.UNKNOWN:
+            continue            # F=64 overflow: sound, just imprecise
+        assert (int(status) == LJ.VALID) == hr.valid, f"seed={seed}"
+        if int(status) == LJ.INVALID:
+            assert int(segs.seg_index[int(fa)]) == hr.op_index, \
+                f"seed={seed}"
+        hits += 1
+    assert hits > 60      # the fuzz must mostly exercise the engine
+
+
 def test_analysis_device_backend():
     rng = random.Random(5)
     h = histgen.register_history(rng, n_procs=3, n_events=40)
