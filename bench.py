@@ -59,18 +59,26 @@ def _run_bench() -> None:
     succ = LJ.pad_succ(mm.succ, 64, 64)
     segs = LJ.make_segments(packed)
     # the production even-bucketed slot width (see linear._analyze_device)
-    # and the production small tier (Fs=32, which serves ~96% of
-    # segments). F=128 covers this history's measured worst segment (88
-    # configs); production's escalation ladder starts at 256 — the
-    # big-tier width only matters for the 4% of segments the small tier
-    # can't serve, so this benches the adaptive shape faithfully.
+    # and the production engines: the fused Pallas kernel (the whole
+    # segment loop in one kernel per 1024-segment chunk, F=128) with
+    # the adaptive two-tier XLA engine as fallback. F=128 covers this
+    # history's measured worst segment (88 configs).
     F, Fs, P = 128, 32, N_PROCS + (N_PROCS & 1)
+    sizes = dict(n_states=mm.n_states, n_transitions=mm.n_transitions)
+
+    from comdb2_tpu.checker import pallas_seg as PSEG
+    use_fused = PSEG.spec_for(mm.n_states, mm.n_transitions, P,
+                              segs.inv_proc.shape[1]) is not None
 
     def run():
+        if use_fused:
+            r = PSEG.check_device_pallas(mm.succ, segs, P=P, **sizes)
+            # overflow falls back to the XLA engine, like production
+            if r is not None and r[0] != LJ.UNKNOWN:
+                return r[0]
         status, fail_seg, n = LJ.check_device_seg2(
             succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
-            F=F, Fs=Fs, P=P,
-            n_states=mm.n_states, n_transitions=mm.n_transitions)
+            F=F, Fs=Fs, P=P, **sizes)
         jax.block_until_ready(status)
         return int(status)
 

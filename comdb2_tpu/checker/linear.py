@@ -137,10 +137,45 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     # shape); even-bucketing keeps recompiles bounded
     P2 = P + (P & 1)
     P2 = max(P2, 2)
+    # fused-kernel fast path: the whole segment loop runs inside one
+    # Pallas kernel per 1024-segment chunk (checker/pallas_seg.py),
+    # ~4x the XLA engines on a real TPU. F is fixed at 128 there;
+    # overflow (UNKNOWN) falls through to the XLA capacity ladder, any
+    # other unavailability (CPU backend, key budget, table size, P > 7)
+    # falls back silently.
+    from . import pallas_seg as PSEG
+
+    P_k = P2 if P2 <= 7 else P
+    r = None
+    # available() probes Mosaic support once per process; past that
+    # gate, errors are real bugs (or a raising progress callback) and
+    # must propagate, not silently rerun on the XLA path
+    if P_k <= 7 and PSEG.available():
+        if progress is None:
+            r = PSEG.check_device_pallas(
+                mm.succ, segs, n_states=mm.n_states,
+                n_transitions=mm.n_transitions, P=P_k)
+        else:
+            r = PSEG.check_device_pallas_chunked(
+                mm.succ, segs, n_states=mm.n_states,
+                n_transitions=mm.n_transitions, P=P_k,
+                progress=progress,
+                progress_interval_s=progress_interval_s,
+                s_real=s_real)
+    if r is not None:
+        status, fail_seg, n_final = r
+        info["engine"] = "pallas-fused"
+        info["frontier_capacity"] = PSEG.F
+        if status != LJ.UNKNOWN:
+            info["time_s"] = time.monotonic() - t0
+            return _device_verdict(mm, packed, segs, status, fail_seg,
+                                   n_final, info)
+
     # the adaptive engine's small tier: most segments' closed frontiers
     # are tiny (p50 ~ 8 configs on the register bench), so each segment
     # first runs at Fs and escalates to F per-segment on overflow (the
     # engine degrades to big-only when F is too small for the tier)
+    info.pop("engine", None)
     Fs = 32
     for F in capacities:
         if progress is None:
@@ -179,6 +214,15 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
         if status != LJ.UNKNOWN:
             break
     info["time_s"] = time.monotonic() - t0
+    return _device_verdict(mm, packed, segs, status, fail_seg, n_final,
+                           info)
+
+
+def _device_verdict(mm, packed, segs, status, fail_seg, n_final,
+                    info) -> Analysis:
+    """Decode an engine's (status, fail_segment, n) into an Analysis."""
+    from . import linear_jax as LJ
+
     fail_at = (int(segs.seg_index[int(fail_seg)])
                if int(fail_seg) >= 0 else -1)
     if status == LJ.VALID:

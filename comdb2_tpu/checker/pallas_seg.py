@@ -1,0 +1,573 @@
+"""Fully-fused Pallas TPU engine for the segmented frontier search.
+
+The XLA engines (:mod:`.linear_jax`) express one closure iteration as
+~40 small device ops; on a 50k-op history the per-op fixed overhead —
+not the arithmetic — dominates (measured ~45 us/iteration on v5e while
+the same data fits one vector register). This engine instead runs the
+ENTIRE segment loop inside one Pallas kernel per 1024-segment chunk:
+
+- The frontier is an ``(8, 128)`` int32 key-pair buffer — exactly one
+  vreg per word — living in VMEM scratch that persists across the
+  sequential grid. Row 0 holds the F=128 config frontier; rows 1..P
+  hold the P candidate chunks of a closure expansion (hence P <= 7).
+- A config is a packed (hi, lo) key, fields as in
+  ``linear_jax.KeyLayout``: P slot fields (0=linearized, 1=idle,
+  t+2=pending transition t) then the state field. Invalid lanes hold a
+  sentinel (hi = 1<<30) that sorts after every valid key.
+- Dedup = full 1024-lane bitonic sort over the flattened buffer (55
+  compare-exchange stages, each ~a dozen single-vreg VPU ops), duplicate
+  marking via neighbour compare, then a second sort to compact
+  survivors into row 0. Exact, like the XLA engines' sort-adjacency
+  dedup — never hash-fingerprint ordering.
+- The memoized successor table rides in VMEM as a flat (8, 128) block;
+  ``succ[s, t]`` is an unrolled row-broadcast + per-lane
+  ``take_along_axis`` gather (Mosaic supports same-shape lane gathers),
+  so the whole model step stays in-kernel. Requires
+  n_states * n_transitions <= 1024.
+- The segment stream (ok_proc, depth, invokes) is a scalar-prefetch
+  array; SMEM bounds it to ~1.5k segments per call, so the host jits a
+  ``lax.scan`` over 1024-segment chunks, carrying the frontier buffers
+  and (status, fail, n) between calls.
+
+Semantics match ``check_device_seg`` exactly: per ok-op segment, apply
+invokes, run the linearization closure at most ``depth`` iterations
+(stopping at a fixed point), keep configs whose ok-slot linearized,
+empty frontier => INVALID at that segment, >128 unique configs =>
+UNKNOWN (the reference's OOM-abort contract, ``linear.clj:318-326``).
+Falls back unavailable (see :func:`spec_for`) when P, the key budget,
+or the table don't fit — the driver then uses the XLA engines.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+ROWS, LANES = 8, 128
+N = ROWS * LANES          # flat sort width
+F = LANES                 # frontier capacity (row 0)
+CHUNK = 1024              # segments per kernel call (SMEM-bounded)
+
+SENT_HI = np.int32(1 << 30)
+SENT_LO = np.int32(0)
+
+# status codes (match linear_jax)
+VALID, INVALID, UNKNOWN = 0, 1, 2
+
+
+class SegKernelSpec(NamedTuple):
+    """Static key layout + table geometry for one compiled kernel."""
+    P: int                 # slot count (<= ROWS - 1)
+    K: int                 # max invokes per segment
+    slot_bits: int
+    state_bits: int
+    # (word, shift) per slot q, and for the state field
+    slot_pos: tuple
+    state_pos: tuple
+    n_states: int
+    n_transitions: int
+    table_rows: int        # ceil(S*T / LANES)
+    chunk: int             # segments per kernel call (SMEM-bounded)
+
+
+def spec_for(n_states: int, n_transitions: int, P: int,
+             K: int) -> Optional[SegKernelSpec]:
+    """Build the static spec, or None when this shape can't run in the
+    fused kernel (caller falls back to the XLA engines)."""
+    if P > ROWS - 1 or K > 8:
+        return None
+    if n_states * n_transitions > N:
+        return None
+    slot_bits = max(int(np.ceil(np.log2(max(n_transitions + 2, 2)))), 1)
+    state_bits = max(int(np.ceil(np.log2(max(n_states, 2)))), 1)
+    pos = []
+    word, shift = 0, 0
+    for width in [slot_bits] * P + [state_bits]:
+        if shift + width > 31:
+            word, shift = word + 1, 0
+        if word > 1 or (word == 1 and shift + width > 30):
+            return None    # hi must stay below the sentinel bit
+        pos.append((word, shift))
+        shift += width
+    table_rows = -(-(n_states * n_transitions) // LANES)
+    # SMEM holds the scalar-prefetch stream: keep chunk * width under
+    # ~56KB (measured limit ~60KB on v5e), in multiples of 128
+    width = 2 + 2 * K
+    chunk = min(CHUNK, (14336 // width) // 128 * 128)
+    return SegKernelSpec(P, K, slot_bits, state_bits,
+                         tuple(pos[:P]), pos[P],
+                         n_states, n_transitions, table_rows, chunk)
+
+
+def pack_table(succ: np.ndarray) -> np.ndarray:
+    """Flatten the successor table into an (8, 128) int32 block
+    (row-major, padded with -1)."""
+    flat = np.full(N, -1, np.int32)
+    flat[:succ.size] = np.ascontiguousarray(succ, np.int32).reshape(-1)
+    return flat.reshape(ROWS, LANES)
+
+
+def initial_frontier(spec: SegKernelSpec):
+    """(hi, lo) (8,128) host arrays: lane 0 of row 0 = the empty config
+    (all slots idle, state 0), everything else sentinel."""
+    hi = np.full((ROWS, LANES), SENT_HI, np.int32)
+    lo = np.full((ROWS, LANES), SENT_LO, np.int32)
+    h0 = l0 = 0
+    for q in range(spec.P):
+        w, sh = spec.slot_pos[q]
+        if w == 0:
+            l0 |= 1 << sh          # IDLE = 1
+        else:
+            h0 |= 1 << sh
+    hi[0, 0] = h0
+    lo[0, 0] = l0
+    return hi, lo
+
+
+# --- kernel body helpers (traced; all shapes static) ------------------------
+
+def _iotas():
+    import jax.numpy as jnp
+    from jax import lax
+
+    row = lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
+    lane = lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1)
+    return row, lane, row * LANES + lane
+
+
+def _fetch(x, j, lane):
+    """Values at flat positions f+j and f-j (circular over the (8,128)
+    row-major order). j is a static power of two."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    if j % LANES == 0:
+        r = j // LANES
+        return (pltpu.roll(x, ROWS - r, 0), pltpu.roll(x, r, 0))
+    a = pltpu.roll(x, LANES - j, 1)          # (i, l) <- (i, (l+j)%128)
+    b = pltpu.roll(a, ROWS - 1, 0)           # <- (i+1, (l+j)%128)
+    plus = jnp.where(lane + j < LANES, a, b)
+    c = pltpu.roll(x, j, 1)                  # (i, l) <- (i, (l-j)%128)
+    d = pltpu.roll(c, 1, 0)                  # <- (i-1, ...)
+    minus = jnp.where(lane - j >= 0, c, d)
+    return plus, minus
+
+
+def _sort_flat(h, l):
+    """Full ascending bitonic sort of the 1024 flat (hi, lo) pairs."""
+    import jax.numpy as jnp
+
+    _, lane, flat = _iotas()
+    k = 2
+    while k <= N:
+        j = k // 2
+        while j >= 1:
+            is_low = (flat & j) == 0
+            asc = (flat & k) == 0 if k < N else (flat >= 0)
+            hp, hm = _fetch(h, j, lane)
+            lp, lm = _fetch(l, j, lane)
+            ph = jnp.where(is_low, hp, hm)
+            pl_ = jnp.where(is_low, lp, lm)
+            mine_less = (h < ph) | ((h == ph) & (l < pl_))
+            min_h = jnp.where(mine_less, h, ph)
+            min_l = jnp.where(mine_less, l, pl_)
+            max_h = jnp.where(mine_less, ph, h)
+            max_l = jnp.where(mine_less, pl_, l)
+            take_min = is_low == asc
+            h = jnp.where(take_min, min_h, max_h)
+            l = jnp.where(take_min, min_l, max_l)
+            j //= 2
+        k *= 2
+    return h, l
+
+
+def _dedup_count(h, l):
+    """After a sort: mark duplicate neighbours, return (h', l', n) with
+    dups sentinelled and n = number of unique valid keys."""
+    import jax.numpy as jnp
+
+    _, lane, flat = _iotas()
+    # previous element = fetch at flat position -1
+    _, prev_h = _fetch(h, 1, lane)
+    _, prev_l = _fetch(l, 1, lane)
+    valid = h < SENT_HI
+    dup = valid & (h == prev_h) & (l == prev_l) & (flat > 0)
+    keep = valid & ~dup
+    n = jnp.sum(keep.astype(jnp.int32))
+    h2 = jnp.where(keep, h, SENT_HI)
+    l2 = jnp.where(keep, l, SENT_LO)
+    return h2, l2, n
+
+
+def _field(spec, h, l, pos, bits):
+    word, sh = pos
+    src = l if word == 0 else h
+    return (src >> sh) & ((1 << bits) - 1)
+
+
+def _field_add(spec, h, l, pos, delta):
+    """Add a (vector) delta into a field; caller guarantees the field
+    stays in range so no borrow crosses field boundaries."""
+    word, sh = pos
+    if word == 0:
+        return h, l + (delta << sh)
+    return h + (delta << sh), l
+
+
+def _gather_table(table, idx, table_rows):
+    """table[(8,128)] flat-indexed gather: out[e] = table_flat[idx[e]],
+    idx < table_rows*128. Unrolled row-broadcast + lane gather."""
+    import jax.numpy as jnp
+
+    out = jnp.full((ROWS, LANES), -1, jnp.int32)
+    r = idx >> 7
+    c = idx & 127
+    for rr in range(table_rows):
+        rowv = jnp.broadcast_to(table[rr:rr + 1, :], (ROWS, LANES))
+        g = jnp.take_along_axis(rowv, c, axis=1)
+        out = jnp.where(r == rr, g, out)
+    return out
+
+
+def _expand(spec, table, h, l):
+    """Rows 1..P <- candidates (slot q of each frontier config
+    linearized), rows P+1.. <- sentinel. Row 0 (the frontier) is kept."""
+    import jax.numpy as jnp
+
+    row, _, _ = _iotas()
+    fh = jnp.broadcast_to(h[0:1, :], (ROWS, LANES))
+    fl = jnp.broadcast_to(l[0:1, :], (ROWS, LANES))
+    fvalid = fh < SENT_HI
+    s = _field(spec, fh, fl, spec.state_pos, spec.state_bits)
+    out_h, out_l = h, l
+    for q in range(spec.P):
+        tq = _field(spec, fh, fl, spec.slot_pos[q], spec.slot_bits)
+        pending = tq >= 2
+        idx = s * spec.n_transitions + jnp.maximum(tq - 2, 0)
+        s2 = _gather_table(table, idx, spec.table_rows)
+        ok = fvalid & pending & (s2 >= 0)
+        ch, cl = _field_add(spec, fh, fl, spec.slot_pos[q], -tq)
+        ch, cl = _field_add(spec, ch, cl, spec.state_pos, s2 - s)
+        m = row == (q + 1)
+        out_h = jnp.where(m, jnp.where(ok, ch, SENT_HI), out_h)
+        out_l = jnp.where(m, jnp.where(ok, cl, SENT_LO), out_l)
+    m_pad = row > spec.P
+    out_h = jnp.where(m_pad, SENT_HI, out_h)
+    out_l = jnp.where(m_pad, SENT_LO, out_l)
+    return out_h, out_l
+
+
+def _slot_field_runtime(spec, h, l, p):
+    """Extract slot p where p is a runtime scalar (unrolled select)."""
+    import jax.numpy as jnp
+
+    out = jnp.zeros((ROWS, LANES), jnp.int32)
+    for q in range(spec.P):
+        out = jnp.where(p == q,
+                        _field(spec, h, l, spec.slot_pos[q],
+                               spec.slot_bits),
+                        out)
+    return out
+
+
+def _slot_add_runtime(spec, h, l, p, delta, mask):
+    """Add delta to slot p (runtime scalar) on lanes where mask."""
+    import jax.numpy as jnp
+
+    for q in range(spec.P):
+        h2, l2 = _field_add(spec, h, l, spec.slot_pos[q], delta)
+        m = mask & (p == q)
+        h = jnp.where(m, h2, h)
+        l = jnp.where(m, l2, l)
+    return h, l
+
+
+def _build_kernel(spec: SegKernelSpec):
+    """The chunk kernel. Grid = (CHUNK,); scalar-prefetch args:
+    seg[CHUNK, 2+2K] (ok_proc, depth, inv_proc.., inv_tr..) and
+    off[1] (global segment offset). Inputs: carry_hi, carry_lo (8,128),
+    carry_stat (1,128) [status, fail, n], table (8,128).
+    Outputs: same three carries."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    P, K = spec.P, spec.K
+
+    def kernel(seg_ref, off_ref, hi_in, lo_in, st_in, tab_ref,
+               hi_out, lo_out, st_out, whi, wlo, sstat):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            whi[:] = hi_in[:]
+            wlo[:] = lo_in[:]
+            sstat[0] = st_in[0, 0]      # status
+            sstat[1] = st_in[0, 1]      # fail seg (global)
+            sstat[2] = st_in[0, 2]      # frontier count
+
+        ok_p = seg_ref[i, 0]
+        depth = seg_ref[i, 1]
+        live = (sstat[0] == VALID) & (ok_p >= 0)
+
+        @pl.when(live)
+        def _():
+            row, _, _ = _iotas()
+            h, l = whi[:], wlo[:]
+            table = tab_ref[:]
+            frow = row == 0
+            # --- invokes: slot p IDLE(1) -> tr+2 (delta tr+1) --------
+            for k in range(K):
+                p = seg_ref[i, 2 + k]
+                tr = seg_ref[i, 2 + K + k]
+                m = frow & (h < SENT_HI) & (p >= 0)
+                h, l = _slot_add_runtime(spec, h, l, p, tr + 1, m)
+
+            # --- closure: bounded fixed point ------------------------
+            # sstat[3]: continue flag, sstat[4]: overflow, sstat[5]: n
+            sstat[3] = 1
+            sstat[4] = 0
+            sstat[5] = sstat[2]
+
+            def body(it, carry):
+                ch, cl = carry
+
+                def run(args):
+                    ch, cl = args
+                    eh, el = _expand(spec, table, ch, cl)
+                    eh, el = _sort_flat(eh, el)
+                    eh, el, n2 = _dedup_count(eh, el)
+                    eh, el = _sort_flat(eh, el)
+                    ovf = (n2 > F).astype(jnp.int32)
+                    changed = (n2 > sstat[5]).astype(jnp.int32)
+                    sstat[4] = sstat[4] | ovf
+                    sstat[3] = changed & (1 - ovf)
+                    sstat[5] = n2
+                    return eh, el
+
+                return lax.cond(sstat[3] == 1, run, lambda a: a,
+                                (ch, cl))
+
+            h, l = lax.fori_loop(0, depth, body, (h, l))
+
+            # --- ok filter: keep configs whose ok-slot linearized ----
+            tq_ok = _slot_field_runtime(spec, h, l, ok_p)
+            returned = frow & (h < SENT_HI) & (tq_ok == 0)
+            # clear the slot back to IDLE (LIN=0 -> +1)
+            h, l = _slot_add_runtime(spec, h, l, ok_p, 1, returned)
+            h = jnp.where(frow & ~returned, SENT_HI, h)
+            l = jnp.where(frow & ~returned, SENT_LO, l)
+            n2 = jnp.sum(returned.astype(jnp.int32))
+
+            ovf = sstat[4] == 1
+            st_new = jnp.where(ovf, UNKNOWN,
+                               jnp.where(n2 == 0, INVALID, VALID))
+            sstat[1] = jnp.where(st_new == VALID, sstat[1],
+                                 off_ref[0] + i)
+            sstat[0] = st_new
+            sstat[2] = n2
+            whi[:] = h
+            wlo[:] = l
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            hi_out[:] = whi[:]
+            lo_out[:] = wlo[:]
+            _, lane0, _ = _iotas()
+            stat_row = jnp.where(
+                lane0[0:1, :] == 0, sstat[0],
+                jnp.where(lane0[0:1, :] == 1, sstat[1],
+                          jnp.where(lane0[0:1, :] == 2, sstat[2], 0)))
+            st_out[:] = stat_row
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _chunk_call(spec: SegKernelSpec):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = _build_kernel(spec)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(spec.chunk,),
+        in_specs=[
+            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((ROWS, LANES), jnp.int32),
+                        pltpu.VMEM((ROWS, LANES), jnp.int32),
+                        pltpu.SMEM((8,), jnp.int32)])
+
+    def call(seg, off, hi, lo, stat, table):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
+                       jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
+                       jax.ShapeDtypeStruct((1, LANES), jnp.int32)],
+        )(seg, off, hi, lo, stat, table)
+
+    return call
+
+
+def pack_segments(segs, spec: SegKernelSpec) -> np.ndarray:
+    """SegmentStream -> (n_chunks, chunk, 2+2K) scalar array, padded
+    with dead segments (ok_proc = -1)."""
+    S = segs.ok_proc.shape[0]
+    K, chunk = spec.K, spec.chunk
+    n_chunks = max(-(-S // chunk), 1)
+    W = 2 + 2 * K
+    out = np.zeros((n_chunks, chunk, W), np.int32)
+    out[:, :, 0] = -1
+    flat = out.reshape(n_chunks * chunk, W)
+    flat[:S, 0] = segs.ok_proc
+    flat[:S, 1] = segs.depth
+    k_in = segs.inv_proc.shape[1]
+    flat[:S, 2:2 + k_in] = segs.inv_proc
+    flat[:S, 2 + K:2 + K + k_in] = segs.inv_tr
+    if k_in < K:
+        flat[:S, 2 + k_in:2 + K] = -1
+    return out
+
+
+@functools.lru_cache(maxsize=32)
+def _scan_fn(spec: SegKernelSpec):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    call = _chunk_call(spec)
+
+    @jax.jit
+    def run(seg_chunks, hi0, lo0, stat0, table):
+        n_chunks = seg_chunks.shape[0]
+
+        def step(carry, x):
+            hi, lo, stat = carry
+            seg, off = x
+
+            def live(_):
+                return tuple(call(seg, off, hi, lo, stat, table))
+
+            hi2, lo2, stat2 = lax.cond(stat[0, 0] == VALID, live,
+                                       lambda _: (hi, lo, stat), None)
+            return (hi2, lo2, stat2), None
+
+        offs = (jnp.arange(n_chunks, dtype=jnp.int32)
+                * jnp.int32(spec.chunk)).reshape(n_chunks, 1)
+        (hi, lo, stat), _ = lax.scan(step, (hi0, lo0, stat0),
+                                     (seg_chunks, offs))
+        return hi, lo, stat
+
+    return run
+
+
+def check_device_pallas(succ: np.ndarray, segs, *, n_states: int,
+                        n_transitions: int, P: int):
+    """Run the fused-kernel search. Returns (status, fail_seg, n) as
+    Python ints, or None when the shape can't run fused."""
+    import jax.numpy as jnp
+
+    prep = _prepare(succ, segs, n_states, n_transitions, P)
+    if prep is None:
+        return None
+    spec, seg_chunks, hi0, lo0, stat0, table = prep
+    run = _scan_fn(spec)
+    hi, lo, stat = run(jnp.asarray(seg_chunks), hi0, lo0, stat0, table)
+    stat = np.asarray(stat)
+    return int(stat[0, 0]), int(stat[0, 1]), int(stat[0, 2])
+
+
+@functools.lru_cache(maxsize=32)
+def _chunk_jit(spec: SegKernelSpec):
+    import jax
+
+    return jax.jit(_chunk_call(spec))
+
+
+def _prepare(succ, segs, n_states, n_transitions, P):
+    """Shared entry-point setup: spec gate, chunked segment stream,
+    initial frontier + stat row (status/fail/n in lanes 0..2 — must
+    match the kernel's sstat indices), packed table. Returns None when
+    the shape can't run fused."""
+    import jax.numpy as jnp
+
+    K = segs.inv_proc.shape[1]
+    spec = spec_for(n_states, n_transitions, P, K)
+    if spec is None:
+        return None
+    seg_chunks = pack_segments(segs, spec)
+    hi, lo = (jnp.asarray(a) for a in initial_frontier(spec))
+    stat0 = np.zeros((1, LANES), np.int32)
+    stat0[0, 0] = VALID
+    stat0[0, 1] = -1
+    stat0[0, 2] = 1
+    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions]))
+    return spec, seg_chunks, hi, lo, jnp.asarray(stat0), table
+
+
+def check_device_pallas_chunked(succ: np.ndarray, segs, *,
+                                n_states: int, n_transitions: int,
+                                P: int, progress=None,
+                                progress_interval_s: float = 5.0,
+                                s_real: Optional[int] = None):
+    """Chunk-at-a-time variant: returns to the host between kernel
+    calls so ``progress(done, total, frontier_n)`` can fire (the
+    reference's 5-second reporter cadence, ``linear.clj:273-297``)."""
+    import time
+
+    import jax.numpy as jnp
+
+    prep = _prepare(succ, segs, n_states, n_transitions, P)
+    if prep is None:
+        return None
+    spec, seg_chunks, hi, lo, stat, table = prep
+    call = _chunk_jit(spec)
+    s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
+    last = time.monotonic()
+    for c in range(seg_chunks.shape[0]):
+        off = np.array([c * spec.chunk], np.int32)
+        hi, lo, stat = call(jnp.asarray(seg_chunks[c]),
+                            jnp.asarray(off), hi, lo, stat, table)
+        st = np.asarray(stat)
+        if int(st[0, 0]) != VALID:
+            break
+        now = time.monotonic()
+        if progress is not None and now - last >= progress_interval_s:
+            progress(min((c + 1) * spec.chunk, s_real), s_real,
+                     int(st[0, 2]))
+            last = now
+    st = np.asarray(stat)
+    return int(st[0, 0]), int(st[0, 1]), int(st[0, 2])
+
+
+@functools.lru_cache(maxsize=1)
+def available() -> bool:
+    """Probe once whether the fused kernel compiles and runs here."""
+    try:
+        from .linear_jax import make_segments
+        from ..ops.packed import pack_history
+        from ..ops import op as O
+
+        h = [O.invoke(0, "w", 1), O.ok(0, "w", 1)]
+        packed = pack_history(h)
+        segs = make_segments(packed)
+        succ = np.array([[0]], np.int32)
+        r = check_device_pallas(succ, segs, n_states=1,
+                                n_transitions=1, P=1)
+        return r is not None and r[0] == VALID
+    except Exception:
+        return False

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""TPU fuzz: the fused Pallas segment engine vs the XLA seg engine.
+
+Usage: PYTHONPATH=$AXON_SITE:. python scripts/fuzz_pallas_seg.py [n]
+Runs n seeded random register histories (valid + mutated-invalid,
+with process retirement via :info ops) through both engines and
+asserts identical verdicts, fail indices, and — for valid runs —
+final frontier counts. On UNKNOWN only the verdict and fail segment
+are compared: the post-abort frontier count is a truncation
+diagnostic and legitimately differs between engines.
+"""
+from __future__ import annotations
+
+import random
+import sys
+from collections import Counter
+
+
+def main() -> None:
+    from comdb2_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+
+    from comdb2_tpu.checker import pallas_seg as PS
+    from comdb2_tpu.checker import linear_jax as LJ
+    from comdb2_tpu.models.memo import memo as make_memo
+    from comdb2_tpu.models import model as M
+    from comdb2_tpu.ops.packed import pack_history
+    from comdb2_tpu.ops.synth import register_history, mutate
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+    c = Counter()
+    for seed in range(500, 500 + n):
+        rng = random.Random(seed)
+        h = register_history(rng, n_procs=rng.randint(2, 5),
+                             n_events=rng.randint(10, 60),
+                             values=3, p_info=0.05)
+        if rng.random() < 0.5:
+            h = mutate(rng, h)
+        packed = pack_history(h)
+        mm = make_memo(M.cas_register(), packed)
+        P = len(packed.process_table)
+        segs = LJ.make_segments(packed, s_pad=64, k_pad=8)
+        if P > 7 or segs.inv_proc.shape != (64, 8) or mm.n_states > 8 \
+           or mm.n_transitions > 32:
+            c["skip"] += 1
+            continue
+        succ = LJ.pad_succ(mm.succ, 8, 32)
+        r = PS.check_device_pallas(succ, segs, n_states=8,
+                                   n_transitions=32, P=P)
+        if r is None:
+            c["nofit"] += 1
+            continue
+        st, fa, n_f = r
+        st2, fa2, n2 = LJ.check_device_seg(
+            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+            F=128, P=P, n_states=8, n_transitions=32)
+        st2, fa2, n2 = int(st2), int(fa2), int(n2)
+        assert st == st2, f"seed={seed}: pallas {r} xla {(st2, fa2, n2)}"
+        if st != 0:
+            assert fa == fa2, f"seed={seed}: fail {fa} vs {fa2}"
+        else:
+            assert n_f == n2, f"seed={seed}: n {n_f} vs {n2}"
+        c["ok" if st == 0 else ("inv" if st == 1 else "unk")] += 1
+    print(dict(c))
+    assert c["ok"] and c["inv"], "fuzz must exercise both verdicts"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Fused Pallas segment engine: availability gating + CPU fallback.
+
+The kernel itself only lowers on TPU (Mosaic); these CPU-mesh tests
+check the graceful-degradation contract — spec gating, fallback in the
+driver — and the host-side packing helpers. The TPU correctness fuzz
+(vs the XLA engine, 120 seeds incl. mutated histories) lives in
+``scripts/fuzz_pallas_seg.py`` and is exercised on real hardware.
+"""
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.checker import pallas_seg as PS
+from comdb2_tpu.checker import linear_jax as LJ
+from comdb2_tpu.checker import analysis
+from comdb2_tpu.models import model as M
+from comdb2_tpu.models.memo import memo as make_memo
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.packed import pack_history
+
+
+def test_spec_gating():
+    s = PS.spec_for(8, 32, 7, 4)
+    assert s is not None and s.table_rows == 2
+    assert PS.spec_for(8, 32, 8, 4) is None          # P > 7
+    assert PS.spec_for(64, 64, 2, 4) is None         # table > 1024
+    assert PS.spec_for(2, 2, 1, 9) is None           # K > 8
+    # key budget: huge transition space overflows the two words
+    assert PS.spec_for(8, 1 << 28, 2, 4) is None
+
+
+def test_spec_chunk_shrinks_with_k():
+    wide = PS.spec_for(4, 4, 2, 8)
+    narrow = PS.spec_for(4, 4, 2, 2)
+    assert wide is not None and narrow is not None
+    assert wide.chunk <= narrow.chunk
+    assert wide.chunk * (2 + 2 * wide.K) <= 14336
+
+
+def test_pack_segments_pads_dead():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1)]
+    packed = pack_history(h)
+    segs = LJ.make_segments(packed)
+    spec = PS.spec_for(4, 4, 1, segs.inv_proc.shape[1])
+    chunks = PS.pack_segments(segs, spec)
+    assert chunks.shape[0] == 1
+    flat = chunks.reshape(-1, 2 + 2 * spec.K)
+    assert (flat[1:, 0] == -1).all()        # padding segments dead
+    assert flat[0, 0] == 0                  # the real ok
+
+
+def test_initial_frontier_layout():
+    spec = PS.spec_for(4, 4, 3, 2)
+    hi, lo = PS.initial_frontier(spec)
+    assert hi.shape == (PS.ROWS, PS.LANES)
+    # exactly one valid lane
+    assert int((hi < PS.SENT_HI).sum()) == 1
+    # every slot field of the root config reads IDLE (1)
+    for q in range(spec.P):
+        w, sh = spec.slot_pos[q]
+        word = hi[0, 0] if w else lo[0, 0]
+        assert (int(word) >> sh) & ((1 << spec.slot_bits) - 1) == 1
+
+
+def test_driver_falls_back_without_mosaic():
+    """On the CPU mesh the kernel can't lower; analysis() must still
+    produce the right verdicts through the XLA engines."""
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(1, "read", None), O.ok(1, "read", 1)] * 40
+    a = analysis(M.register(), h, backend="device")
+    assert a.valid is True
+    assert a.info.get("engine") != "pallas-fused"
+
+
+def test_check_device_pallas_none_when_unfit():
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1)]
+    packed = pack_history(h)
+    mm = make_memo(M.register(), packed)
+    segs = LJ.make_segments(packed)
+    r = PS.check_device_pallas(mm.succ, segs, n_states=64,
+                               n_transitions=64, P=2)
+    assert r is None                        # table too large: no fit
