@@ -155,6 +155,20 @@ def _fetch(x, j, lane):
     return plus, minus
 
 
+def _cmp_exchange(h, l, ph, pl_, take_min):
+    """One bitonic compare-exchange: keep the lexicographic min or max
+    of (h, l) vs the partner (ph, pl_) per lane."""
+    import jax.numpy as jnp
+
+    mine_less = (h < ph) | ((h == ph) & (l < pl_))
+    min_h = jnp.where(mine_less, h, ph)
+    min_l = jnp.where(mine_less, l, pl_)
+    max_h = jnp.where(mine_less, ph, h)
+    max_l = jnp.where(mine_less, pl_, l)
+    return (jnp.where(take_min, min_h, max_h),
+            jnp.where(take_min, min_l, max_l))
+
+
 def _sort_flat(h, l):
     """Full ascending bitonic sort of the 1024 flat (hi, lo) pairs."""
     import jax.numpy as jnp
@@ -170,17 +184,85 @@ def _sort_flat(h, l):
             lp, lm = _fetch(l, j, lane)
             ph = jnp.where(is_low, hp, hm)
             pl_ = jnp.where(is_low, lp, lm)
-            mine_less = (h < ph) | ((h == ph) & (l < pl_))
-            min_h = jnp.where(mine_less, h, ph)
-            min_l = jnp.where(mine_less, l, pl_)
-            max_h = jnp.where(mine_less, ph, h)
-            max_l = jnp.where(mine_less, pl_, l)
-            take_min = is_low == asc
-            h = jnp.where(take_min, min_h, max_h)
-            l = jnp.where(take_min, min_l, max_l)
+            h, l = _cmp_exchange(h, l, ph, pl_, is_low == asc)
             j //= 2
         k *= 2
     return h, l
+
+
+def _sort_row(h, l):
+    """Ascending bitonic sort of the 128 lanes of EVERY row
+    independently (lane rolls only — pairs never cross rows). Used by
+    the mini tier, where the whole frontier+candidates fit one row."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, lane, _ = _iotas()
+    k = 2
+    while k <= LANES:
+        j = k // 2
+        while j >= 1:
+            is_low = (lane & j) == 0
+            asc = (lane & k) == 0 if k < LANES else (lane >= 0)
+            ph = jnp.where(is_low, pltpu.roll(h, LANES - j, 1),
+                           pltpu.roll(h, j, 1))
+            pl_ = jnp.where(is_low, pltpu.roll(l, LANES - j, 1),
+                            pltpu.roll(l, j, 1))
+            h, l = _cmp_exchange(h, l, ph, pl_, is_low == asc)
+            j //= 2
+        k *= 2
+    return h, l
+
+
+MINI = 16       # frontier size served by the single-row tier
+
+
+def _dedup_count_row(h, l):
+    """Row-0 dedup after a row sort: sentinel the duplicate neighbours,
+    count unique valid keys in row 0."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    row, lane, _ = _iotas()
+    prev_h = pltpu.roll(h, 1, 1)
+    prev_l = pltpu.roll(l, 1, 1)
+    valid = h < SENT_HI
+    dup = valid & (h == prev_h) & (l == prev_l) & (lane > 0)
+    keep = valid & ~dup
+    n = jnp.sum((keep & (row == 0)).astype(jnp.int32))
+    return (jnp.where(keep, h, SENT_HI),
+            jnp.where(keep, l, SENT_LO), n)
+
+
+def _mini_expand(spec, table, h, l):
+    """Single-row expansion: frontier in lanes 0..MINI-1 of row 0;
+    candidate chunk q lands at lanes [MINI*(q+1), MINI*(q+2)). All
+    rows compute in lockstep; only row 0 is meaningful."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, lane, _ = _iotas()
+    group = lane // MINI
+    fvalid = (h < SENT_HI) & (lane < MINI)
+    s = _field(spec, h, l, spec.state_pos, spec.state_bits)
+    out_h, out_l = h, l
+    for q in range(spec.P):
+        tq = _field(spec, h, l, spec.slot_pos[q], spec.slot_bits)
+        pending = tq >= 2
+        idx = s * spec.n_transitions + jnp.maximum(tq - 2, 0)
+        s2 = _gather_table(table, idx, spec.table_rows)
+        ok = fvalid & pending & (s2 >= 0)
+        ch, cl = _field_add(spec, h, l, spec.slot_pos[q], -tq)
+        ch, cl = _field_add(spec, ch, cl, spec.state_pos, s2 - s)
+        ch = jnp.where(ok, ch, SENT_HI)
+        cl = jnp.where(ok, cl, SENT_LO)
+        m = group == q + 1
+        out_h = jnp.where(m, pltpu.roll(ch, MINI * (q + 1), 1), out_h)
+        out_l = jnp.where(m, pltpu.roll(cl, MINI * (q + 1), 1), out_l)
+    pad = group > spec.P           # unused groups when P < 7
+    out_h = jnp.where(pad, SENT_HI, out_h)
+    out_l = jnp.where(pad, SENT_LO, out_l)
+    return out_h, out_l
 
 
 def _dedup_count(h, l):
@@ -336,16 +418,49 @@ def _build_kernel(spec: SegKernelSpec):
 
                 def run(args):
                     ch, cl = args
-                    eh, el = _expand(spec, table, ch, cl)
-                    eh, el = _sort_flat(eh, el)
-                    eh, el, n2 = _dedup_count(eh, el)
-                    eh, el = _sort_flat(eh, el)
+
+                    def full(args):
+                        ch, cl = args
+                        eh, el = _expand(spec, table, ch, cl)
+                        eh, el = _sort_flat(eh, el)
+                        eh, el, n2 = _dedup_count(eh, el)
+                        return eh, el, n2
+
+                    def mini(args):
+                        # frontier fits one 16-lane group: the whole
+                        # iteration stays in row 0 and the sorts are
+                        # 28 lane-only stages instead of 55 flat ones
+                        ch, cl = args
+                        eh, el = _mini_expand(spec, table, ch, cl)
+                        eh, el = _sort_row(eh, el)
+                        eh, el, n2 = _dedup_count_row(eh, el)
+                        nrow = row > 0
+                        eh = jnp.where(nrow, SENT_HI, eh)
+                        el = jnp.where(nrow, SENT_LO, el)
+                        return eh, el, n2
+
+                    use_mini = sstat[5] <= MINI
+                    eh, el, n2 = lax.cond(use_mini, mini, full,
+                                          (ch, cl))
                     ovf = (n2 > F).astype(jnp.int32)
                     changed = (n2 > sstat[5]).astype(jnp.int32)
                     sstat[4] = sstat[4] | ovf
                     sstat[3] = changed & (1 - ovf)
                     sstat[5] = n2
-                    return eh, el
+
+                    def compact2(args):
+                        eh, el, was_mini = args
+                        eh, el = lax.cond(
+                            was_mini,
+                            lambda a: _sort_row(*a),
+                            lambda a: _sort_flat(*a), (eh, el))
+                        return eh, el
+
+                    # no growth => the deduped union IS the previous
+                    # frontier; restore it and skip the compaction sort
+                    return lax.cond(changed == 1, compact2,
+                                    lambda a: (ch, cl),
+                                    (eh, el, use_mini))
 
                 return lax.cond(sstat[3] == 1, run, lambda a: a,
                                 (ch, cl))
@@ -360,6 +475,10 @@ def _build_kernel(spec: SegKernelSpec):
             h = jnp.where(frow & ~returned, SENT_HI, h)
             l = jnp.where(frow & ~returned, SENT_LO, l)
             n2 = jnp.sum(returned.astype(jnp.int32))
+            # re-compact row 0 (survivors are a scatter of the closed
+            # frontier): one row sort keeps the "frontier contiguous
+            # from lane 0" invariant the mini tier relies on
+            h, l = _sort_row(h, l)
 
             ovf = sstat[4] == 1
             st_new = jnp.where(ovf, UNKNOWN,

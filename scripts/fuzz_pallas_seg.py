@@ -16,53 +16,121 @@ import sys
 from collections import Counter
 
 
+def _register_case(rng):
+    from comdb2_tpu.models import model as M
+    from comdb2_tpu.ops.synth import register_history, mutate
+
+    h = register_history(rng, n_procs=rng.randint(2, 5),
+                         n_events=rng.randint(10, 60),
+                         values=3, p_info=0.05)
+    if rng.random() < 0.5:
+        h = mutate(rng, h)
+    return M.cas_register(), h
+
+
+def _cross_model_cases():
+    """(name, case_fn) pairs incl. the cross-model generators the CPU
+    suite uses (tests/test_engine_cross_model.py), with occasional
+    corruption to produce invalid histories."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests")
+    import test_engine_cross_model as X
+
+    def corrupt(rng, h):
+        """Model-agnostic corruption (same scheme as the CPU
+        cross-model test): flip a fail->ok, else falsify an ok value."""
+        h = list(h)
+        fails = [i for i, op in enumerate(h) if op.type == "fail"]
+        oks = [i for i, op in enumerate(h)
+               if op.type == "ok" and op.value is not None]
+        if fails:
+            i = rng.choice(fails)
+            h[i] = h[i].with_(type="ok")
+        elif oks:
+            i = rng.choice(oks)
+            v = h[i].value
+            if isinstance(v, tuple) and v and isinstance(v[0], tuple):
+                mf, k, mv = v[0]
+                h[i] = h[i].with_(value=((mf, k, (mv or 0) + 7),)
+                                  + v[1:])
+            else:
+                h[i] = h[i].with_(value=999)
+        return h
+
+    def mk(mk_model, mk_hist):
+        def case(rng):
+            h = mk_hist(rng, rng.randint(2, 4), rng.randint(10, 50))
+            if rng.random() < 0.4:
+                h = corrupt(rng, h)
+            return mk_model(), h
+        return case
+
+    return ([("register", _register_case)] +
+            [(name, mk(mkm, mkh)) for name, mkm, mkh in X.CASES])
+
+
 def main() -> None:
     from comdb2_tpu.utils.platform import enable_compile_cache
     enable_compile_cache()
 
     from comdb2_tpu.checker import pallas_seg as PS
     from comdb2_tpu.checker import linear_jax as LJ
-    from comdb2_tpu.models.memo import memo as make_memo
-    from comdb2_tpu.models import model as M
+    from comdb2_tpu.models.memo import MemoOverflow, memo as make_memo
     from comdb2_tpu.ops.packed import pack_history
-    from comdb2_tpu.ops.synth import register_history, mutate
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 120
     c = Counter()
-    for seed in range(500, 500 + n):
-        rng = random.Random(seed)
-        h = register_history(rng, n_procs=rng.randint(2, 5),
-                             n_events=rng.randint(10, 60),
-                             values=3, p_info=0.05)
-        if rng.random() < 0.5:
-            h = mutate(rng, h)
-        packed = pack_history(h)
-        mm = make_memo(M.cas_register(), packed)
-        P = len(packed.process_table)
-        segs = LJ.make_segments(packed, s_pad=64, k_pad=8)
-        if P > 7 or segs.inv_proc.shape != (64, 8) or mm.n_states > 8 \
-           or mm.n_transitions > 32:
-            c["skip"] += 1
-            continue
-        succ = LJ.pad_succ(mm.succ, 8, 32)
-        r = PS.check_device_pallas(succ, segs, n_states=8,
-                                   n_transitions=32, P=P)
-        if r is None:
-            c["nofit"] += 1
-            continue
-        st, fa, n_f = r
-        st2, fa2, n2 = LJ.check_device_seg(
-            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
-            F=128, P=P, n_states=8, n_transitions=32)
-        st2, fa2, n2 = int(st2), int(fa2), int(n2)
-        assert st == st2, f"seed={seed}: pallas {r} xla {(st2, fa2, n2)}"
-        if st != 0:
-            assert fa == fa2, f"seed={seed}: fail {fa} vs {fa2}"
-        else:
-            assert n_f == n2, f"seed={seed}: n {n_f} vs {n2}"
-        c["ok" if st == 0 else ("inv" if st == 1 else "unk")] += 1
-    print(dict(c))
-    assert c["ok"] and c["inv"], "fuzz must exercise both verdicts"
+    cases = _cross_model_cases()
+    names = [nm for nm, _ in cases]
+    for name, case in cases:
+        for seed in range(500, 500 + n):
+            rng = random.Random(seed)
+            model, h = case(rng)
+            packed = pack_history(h)
+            try:
+                mm = make_memo(model, packed)
+            except MemoOverflow:
+                c[name, "memo-skip"] += 1
+                continue
+            P = len(packed.process_table)
+            segs = LJ.make_segments(packed, s_pad=64, k_pad=8)
+            # shape buckets (few compiled specs); both fit the 1024-
+            # entry table
+            if mm.n_states <= 8 and mm.n_transitions <= 32:
+                bucket = (8, 32)
+            elif mm.n_states <= 16 and mm.n_transitions <= 64:
+                bucket = (16, 64)
+            else:
+                c[name, "skip"] += 1
+                continue
+            if P > 7 or segs.inv_proc.shape != (64, 8):
+                c[name, "skip"] += 1
+                continue
+            succ = LJ.pad_succ(mm.succ, *bucket)
+            r = PS.check_device_pallas(succ, segs, n_states=bucket[0],
+                                       n_transitions=bucket[1], P=P)
+            if r is None:
+                c[name, "nofit"] += 1
+                continue
+            st, fa, n_f = r
+            st2, fa2, n2 = LJ.check_device_seg(
+                succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
+                segs.depth, F=128, P=P, n_states=bucket[0],
+                n_transitions=bucket[1])
+            st2, fa2, n2 = int(st2), int(fa2), int(n2)
+            assert st == st2, \
+                f"{name} seed={seed}: pallas {r} xla {(st2, fa2, n2)}"
+            if st != 0:
+                assert fa == fa2, f"{name} seed={seed}: {fa} vs {fa2}"
+            else:
+                assert n_f == n2, f"{name} seed={seed}: {n_f} vs {n2}"
+            c[name, "ok" if st == 0
+              else ("inv" if st == 1 else "unk")] += 1
+        print(name, {k[1]: v for k, v in c.items() if k[0] == name},
+              flush=True)
+    assert any(c[nm, "ok"] for nm in names)
+    assert any(c[nm, "inv"] for nm in names)
 
 
 if __name__ == "__main__":
