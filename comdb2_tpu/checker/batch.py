@@ -21,6 +21,7 @@ from ..ops.op import INVOKE, Op
 from ..ops.packed import PackedHistory, pack_history
 from ..utils import next_pow2 as _next_pow2
 from . import linear_jax as LJ
+from . import pallas_seg as PSEG
 
 
 @dataclass
@@ -127,6 +128,20 @@ def segment_batch(batch: PackedBatch) -> SegmentBatch:
     )
 
 
+def _stream_segments(batch: PackedBatch):
+    """Per-history SegmentStreams with transition ids remapped into the
+    union table (the streamed kernel shares ONE table)."""
+    out = []
+    for i, p in enumerate(batch.packeds):
+        s = LJ.make_segments(p)
+        remap = np.asarray(batch.remaps[i], np.int32)
+        inv_tr = np.where(s.inv_proc >= 0, remap[s.inv_tr],
+                          0).astype(np.int32)
+        out.append(LJ.SegmentStream(s.inv_proc, inv_tr, s.ok_proc,
+                                    s.seg_index, s.depth))
+    return out
+
+
 def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
                 batch_axis: str = "batch", engine: str = "auto"):
     """Run the batched device search; returns (status[N], fail_at[N],
@@ -134,11 +149,13 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
     ``mesh``, the batch axis is sharded across devices (data
     parallelism over ICI).
 
-    engine: "keys" keeps the frontier as packed int32 key pairs —
-    config mutation is bit arithmetic, dedup one sort (fastest);
-    "flat" folds all frontiers into one explicit tensor with the batch
-    id as the top sort key; "vmap" is the per-lane fallback; "auto"
-    picks the best whose key budget fits.
+    engine: "stream" runs all histories through the fused Pallas
+    kernel as one streamed scan (fastest on TPU — measured ~6x the
+    keys engine); "keys" keeps the frontier as packed int32 key pairs
+    — config mutation is bit arithmetic, dedup one sort; "flat" folds
+    all frontiers into one explicit tensor with the batch id as the
+    top sort key; "vmap" is the per-lane fallback; "auto" picks the
+    best available whose budget fits.
     """
     succ = LJ.pad_succ(batch.memo.succ,
                        _next_pow2(batch.memo.succ.shape[0]),
@@ -147,18 +164,57 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
     B = len(batch)
     sizes = {"n_states": batch.memo.n_states,
              "n_transitions": batch.memo.n_transitions}
-    if engine == "auto":
-        lay = LJ.KeyLayout(B, sizes["n_states"], sizes["n_transitions"],
-                           P)
+    P_k = batch.P           # the kernel has no pow2 slot requirement
+
+    def pick_xla_engine():
         if mesh is not None:
-            engine = "vmap"
-        elif lay.fits:
-            engine = "keys"
-        elif LJ.flat_pack_bits(B, sizes["n_states"],
-                               sizes["n_transitions"], P)[3]:
-            engine = "flat"
+            return "vmap"
+        if LJ.KeyLayout(B, sizes["n_states"], sizes["n_transitions"],
+                        P).fits:
+            return "keys"
+        if LJ.flat_pack_bits(B, sizes["n_states"],
+                             sizes["n_transitions"], P)[3]:
+            return "flat"
+        return "vmap"
+
+    if engine == "auto":
+        if (mesh is None and P_k <= 7
+                and PSEG.spec_for(sizes["n_states"],
+                                  sizes["n_transitions"], P_k, 8)
+                is not None and PSEG.available()):
+            engine = "stream"
         else:
-            engine = "vmap"
+            engine = pick_xla_engine()
+    if engine == "stream":
+        rs = None
+        if P_k <= 7 and PSEG.available():
+            segs_list = _stream_segments(batch)
+            rs = PSEG.check_device_pallas_stream(
+                batch.memo.succ, segs_list, P=P_k, **sizes)
+        if rs is not None:
+            status = np.array([r[0] for r in rs], np.int32)
+            fail_at = np.array([
+                segs_list[b].seg_index[rs[b][1]] if rs[b][1] >= 0
+                else -1 for b in range(B)], np.int64)
+            n_final = np.array([r[2] for r in rs], np.int32)
+            # the kernel's frontier is fixed at 128: histories that
+            # overflowed it get their requested budget F through the
+            # XLA engines instead of surfacing spurious UNKNOWNs
+            unk = np.flatnonzero(status == LJ.UNKNOWN)
+            if unk.size and F > PSEG.F:
+                sub = PackedBatch(
+                    packeds=[batch.packeds[i] for i in unk],
+                    memo=batch.memo,
+                    kind=batch.kind[unk], proc=batch.proc[unk],
+                    tr=batch.tr[unk], P=batch.P,
+                    remaps=[batch.remaps[i] for i in unk])
+                st2, fa2, n2 = check_batch(sub, F=F, mesh=mesh,
+                                           engine=pick_xla_engine())
+                status[unk] = st2
+                fail_at[unk] = fa2
+                n_final[unk] = n2
+            return status, fail_at, n_final
+        engine = pick_xla_engine()
     if engine in ("keys", "flat"):
         sb = segment_batch(batch)
         fn = (LJ.check_device_keys if engine == "keys"

@@ -366,32 +366,80 @@ def _slot_add_runtime(spec, h, l, p, delta, mask):
     return h, l
 
 
+RESET = -2     # ok_proc marker: flush current history, start the next
+
+
+def _root_key(spec):
+    """(hi0, lo0) ints of the empty config (all slots IDLE, state 0)."""
+    h0 = l0 = 0
+    for q in range(spec.P):
+        w, sh = spec.slot_pos[q]
+        if w == 0:
+            l0 |= 1 << sh
+        else:
+            h0 |= 1 << sh
+    return h0, l0
+
+
 def _build_kernel(spec: SegKernelSpec):
-    """The chunk kernel. Grid = (CHUNK,); scalar-prefetch args:
-    seg[CHUNK, 2+2K] (ok_proc, depth, inv_proc.., inv_tr..) and
+    """The chunk kernel. Grid = (chunk,); scalar-prefetch args:
+    seg[chunk, 2+2K] (ok_proc, depth, inv_proc.., inv_tr..) and
     off[1] (global segment offset). Inputs: carry_hi, carry_lo (8,128),
-    carry_stat (1,128) [status, fail, n], table (8,128).
-    Outputs: same three carries."""
+    carry_stat (1,128) [status, fail, n, hist-counter], results
+    (B_pad, 128), table (rows,128). Outputs: the same carries.
+
+    A segment with ok_proc == RESET is a history boundary in a
+    multi-history stream: the current history's (status, fail, n) row
+    is stored at results[counter], the counter advances, and the
+    frontier/status reset to the initial state. Single-history runs
+    simply have no RESET segments and ignore the results buffer."""
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl
 
     P, K = spec.P, spec.K
 
-    def kernel(seg_ref, off_ref, hi_in, lo_in, st_in, tab_ref,
-               hi_out, lo_out, st_out, whi, wlo, sstat):
+    root_hi, root_lo = _root_key(spec)
+
+    def kernel(seg_ref, off_ref, hi_in, lo_in, st_in, res_in, tab_ref,
+               hi_out, lo_out, st_out, res_out, whi, wlo, sstat):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _():
             whi[:] = hi_in[:]
             wlo[:] = lo_in[:]
+            res_out[:] = res_in[:]
             sstat[0] = st_in[0, 0]      # status
             sstat[1] = st_in[0, 1]      # fail seg (global)
             sstat[2] = st_in[0, 2]      # frontier count
+            sstat[6] = st_in[0, 3]      # history counter (stream mode)
 
         ok_p = seg_ref[i, 0]
         depth = seg_ref[i, 1]
+
+        @pl.when(ok_p == RESET)
+        def _():
+            row, lane, _ = _iotas()
+            cnt = sstat[6]
+
+            @pl.when(cnt >= 0)
+            def _():
+                stat_row = jnp.where(
+                    lane[0:1, :] == 0, sstat[0],
+                    jnp.where(lane[0:1, :] == 1, sstat[1],
+                              jnp.where(lane[0:1, :] == 2, sstat[2],
+                                        0)))
+                res_out[pl.ds(cnt, 1), :] = stat_row
+
+            sstat[6] = cnt + 1
+            sstat[0] = VALID
+            sstat[1] = -1
+            sstat[2] = 1
+            root = (row == 0) & (lane == 0)
+            whi[:] = jnp.where(root, root_hi, SENT_HI)
+            wlo[:] = jnp.where(root, root_lo, SENT_LO)
+
         live = (sstat[0] == VALID) & (ok_p >= 0)
 
         @pl.when(live)
@@ -498,14 +546,18 @@ def _build_kernel(spec: SegKernelSpec):
             stat_row = jnp.where(
                 lane0[0:1, :] == 0, sstat[0],
                 jnp.where(lane0[0:1, :] == 1, sstat[1],
-                          jnp.where(lane0[0:1, :] == 2, sstat[2], 0)))
+                          jnp.where(lane0[0:1, :] == 2, sstat[2],
+                                    jnp.where(lane0[0:1, :] == 3,
+                                              sstat[6], 0))))
             st_out[:] = stat_row
 
     return kernel
 
 
 @functools.lru_cache(maxsize=32)
-def _chunk_call(spec: SegKernelSpec):
+def _chunk_call(spec: SegKernelSpec, b_pad: int = 8):
+    """b_pad: rows of the per-history results buffer (multi-history
+    streams); single-history runs pass a dummy 8-row buffer."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -519,25 +571,28 @@ def _chunk_call(spec: SegKernelSpec):
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((b_pad, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((b_pad, LANES), lambda i, *s: (0, 0)),
         ],
         scratch_shapes=[pltpu.VMEM((ROWS, LANES), jnp.int32),
                         pltpu.VMEM((ROWS, LANES), jnp.int32),
                         pltpu.SMEM((8,), jnp.int32)])
 
-    def call(seg, off, hi, lo, stat, table):
+    def call(seg, off, hi, lo, stat, res, table):
         return pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
                        jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
-                       jax.ShapeDtypeStruct((1, LANES), jnp.int32)],
-        )(seg, off, hi, lo, stat, table)
+                       jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+                       jax.ShapeDtypeStruct((b_pad, LANES), jnp.int32)],
+        )(seg, off, hi, lo, stat, res, table)
 
     return call
 
@@ -563,33 +618,41 @@ def pack_segments(segs, spec: SegKernelSpec) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=32)
-def _scan_fn(spec: SegKernelSpec):
+def _scan_fn(spec: SegKernelSpec, b_pad: int = 8,
+             stream: bool = False):
+    """Jitted scan over chunk calls. ``stream=False`` short-circuits
+    dead chunks once the (single) history failed; stream mode always
+    runs every chunk (later histories are still live) and threads the
+    per-history results buffer through the scan."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    call = _chunk_call(spec)
+    call = _chunk_call(spec, b_pad)
 
     @jax.jit
-    def run(seg_chunks, hi0, lo0, stat0, table):
+    def run(seg_chunks, hi0, lo0, stat0, res0, table):
         n_chunks = seg_chunks.shape[0]
 
         def step(carry, x):
-            hi, lo, stat = carry
+            hi, lo, stat, res = carry
             seg, off = x
 
             def live(_):
-                return tuple(call(seg, off, hi, lo, stat, table))
+                return tuple(call(seg, off, hi, lo, stat, res, table))
 
-            hi2, lo2, stat2 = lax.cond(stat[0, 0] == VALID, live,
-                                       lambda _: (hi, lo, stat), None)
-            return (hi2, lo2, stat2), None
+            if stream:
+                out = live(None)
+            else:
+                out = lax.cond(stat[0, 0] == VALID, live,
+                               lambda _: (hi, lo, stat, res), None)
+            return out, None
 
         offs = (jnp.arange(n_chunks, dtype=jnp.int32)
                 * jnp.int32(spec.chunk)).reshape(n_chunks, 1)
-        (hi, lo, stat), _ = lax.scan(step, (hi0, lo0, stat0),
-                                     (seg_chunks, offs))
-        return hi, lo, stat
+        (hi, lo, stat, res), _ = lax.scan(
+            step, (hi0, lo0, stat0, res0), (seg_chunks, offs))
+        return hi, lo, stat, res
 
     return run
 
@@ -605,7 +668,9 @@ def check_device_pallas(succ: np.ndarray, segs, *, n_states: int,
         return None
     spec, seg_chunks, hi0, lo0, stat0, table = prep
     run = _scan_fn(spec)
-    hi, lo, stat = run(jnp.asarray(seg_chunks), hi0, lo0, stat0, table)
+    res0 = jnp.zeros((8, LANES), jnp.int32)      # unused: no RESETs
+    hi, lo, stat, _ = run(jnp.asarray(seg_chunks), hi0, lo0, stat0,
+                          res0, table)
     stat = np.asarray(stat)
     return int(stat[0, 0]), int(stat[0, 1]), int(stat[0, 2])
 
@@ -615,6 +680,85 @@ def _chunk_jit(spec: SegKernelSpec):
     import jax
 
     return jax.jit(_chunk_call(spec))
+
+
+def pack_stream(segs_list, spec: SegKernelSpec):
+    """Concatenate per-history segment streams into one chunked stream
+    with RESET markers: [R][h0][R][h1]...[R]. The first R starts
+    history 0 (the counter begins at -1, so nothing is flushed); each
+    later R flushes the previous history; the trailing R flushes the
+    last. Returns (chunks[n,chunk,W], starts[B]) where starts[b] is
+    history b's first segment's global stream index."""
+    B = len(segs_list)
+    W = 2 + 2 * spec.K
+    sizes = [s.ok_proc.shape[0] for s in segs_list]
+    total = sum(sizes) + B + 1
+    chunk = spec.chunk
+    n_chunks = max(-(-total // chunk), 1)
+    flat = np.zeros((n_chunks * chunk, W), np.int32)
+    flat[:, 0] = -1                       # default: dead padding
+    starts = np.zeros(B, np.int64)
+    pos = 0
+    for b, segs in enumerate(segs_list):
+        flat[pos, 0] = RESET
+        pos += 1
+        starts[b] = pos
+        S = sizes[b]
+        k_in = segs.inv_proc.shape[1]
+        flat[pos:pos + S, 0] = segs.ok_proc
+        flat[pos:pos + S, 1] = segs.depth
+        flat[pos:pos + S, 2:2 + k_in] = segs.inv_proc
+        if k_in < spec.K:
+            flat[pos:pos + S, 2 + k_in:2 + spec.K] = -1
+        flat[pos:pos + S, 2 + spec.K:2 + spec.K + k_in] = segs.inv_tr
+        pos += S
+    flat[pos, 0] = RESET                  # trailing flush
+    return flat.reshape(n_chunks, chunk, W), starts
+
+
+def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
+                               n_states: int, n_transitions: int,
+                               P: int):
+    """Check MANY independent histories as one streamed kernel scan —
+    the device form of ``independent/checker``'s per-key partitioning
+    (``independent.clj:252-300``). One dispatch for the whole batch;
+    per-history verdicts come back in the results buffer. Returns a
+    list of (status, fail_seg_local, n) or None when the shape can't
+    run fused. Every history gets its own verdict: one history's
+    INVALID/UNKNOWN never stops the others (the RESET marker restores
+    a live frontier)."""
+    import jax.numpy as jnp
+
+    K = max((s.inv_proc.shape[1] for s in segs_list), default=1)
+    spec = spec_for(n_states, n_transitions, P, K)
+    if spec is None:
+        return None
+    B = len(segs_list)
+    if B == 0:
+        return []
+    b_pad = 8                 # pow2 buckets bound kernel recompiles
+    while b_pad < B:
+        b_pad *= 2
+    chunks, starts = pack_stream(segs_list, spec)
+    hi0, lo0 = (jnp.asarray(a) for a in initial_frontier(spec))
+    stat0 = np.zeros((1, LANES), np.int32)
+    stat0[0, 0] = VALID
+    stat0[0, 1] = -1
+    stat0[0, 2] = 1
+    stat0[0, 3] = -1                      # counter: first R -> 0
+    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions]))
+    run = _scan_fn(spec, b_pad=b_pad, stream=True)
+    res0 = jnp.zeros((b_pad, LANES), jnp.int32)
+    _, _, _, res = run(jnp.asarray(chunks), hi0, lo0,
+                       jnp.asarray(stat0), res0, table)
+    res = np.asarray(res)
+    out = []
+    for b in range(B):
+        st = int(res[b, 0])
+        fail_g = int(res[b, 1])
+        fail_local = fail_g - int(starts[b]) if fail_g >= 0 else -1
+        out.append((st, fail_local, int(res[b, 2])))
+    return out
 
 
 def _prepare(succ, segs, n_states, n_transitions, P):
@@ -634,6 +778,7 @@ def _prepare(succ, segs, n_states, n_transitions, P):
     stat0[0, 0] = VALID
     stat0[0, 1] = -1
     stat0[0, 2] = 1
+    stat0[0, 3] = -1          # history counter (multi-history streams)
     table = jnp.asarray(pack_table(succ[:n_states, :n_transitions]))
     return spec, seg_chunks, hi, lo, jnp.asarray(stat0), table
 
@@ -655,12 +800,14 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
         return None
     spec, seg_chunks, hi, lo, stat, table = prep
     call = _chunk_jit(spec)
+    res = jnp.zeros((8, LANES), jnp.int32)       # unused: no RESETs
     s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
     last = time.monotonic()
     for c in range(seg_chunks.shape[0]):
         off = np.array([c * spec.chunk], np.int32)
-        hi, lo, stat = call(jnp.asarray(seg_chunks[c]),
-                            jnp.asarray(off), hi, lo, stat, table)
+        hi, lo, stat, res = call(jnp.asarray(seg_chunks[c]),
+                                 jnp.asarray(off), hi, lo, stat, res,
+                                 table)
         st = np.asarray(stat)
         if int(st[0, 0]) != VALID:
             break
