@@ -187,7 +187,12 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
             engine = pick_xla_engine()
     if engine == "stream":
         rs = None
-        if P_k <= 7 and PSEG.available():
+        # gate on the spec BEFORE the O(total-ops) segment pass so an
+        # ineligible shape doesn't do the host work twice
+        if (P_k <= 7
+                and PSEG.spec_for(sizes["n_states"],
+                                  sizes["n_transitions"], P_k, 8)
+                is not None and PSEG.available()):
             segs_list = _stream_segments(batch)
             rs = PSEG.check_device_pallas_stream(
                 batch.memo.succ, segs_list, P=P_k, **sizes)

@@ -22,8 +22,8 @@ ENTIRE segment loop inside one Pallas kernel per 1024-segment chunk:
 - The memoized successor table rides in VMEM as a flat (8, 128) block;
   ``succ[s, t]`` is an unrolled row-broadcast + per-lane
   ``take_along_axis`` gather (Mosaic supports same-shape lane gathers),
-  so the whole model step stays in-kernel. Requires
-  n_states * n_transitions <= 1024.
+  so the whole model step stays in-kernel. Tables up to 4096 entries
+  ride a (32, 128) VMEM block (bucketed to bound recompiles).
 - The segment stream (ok_proc, depth, invokes) is a scalar-prefetch
   array; SMEM bounds it to ~1.5k segments per call, so the host jits a
   ``lax.scan`` over 1024-segment chunks, carrying the frontier buffers
@@ -49,12 +49,17 @@ ROWS, LANES = 8, 128
 N = ROWS * LANES          # flat sort width
 F = LANES                 # frontier capacity (row 0)
 CHUNK = 1024              # segments per kernel call (SMEM-bounded)
+MAX_STREAM_B = 2048       # histories per streamed call (VMEM-bounded:
+                          # two (B,128) int32 result blocks = 2 MB)
 
 SENT_HI = np.int32(1 << 30)
 SENT_LO = np.int32(0)
 
 # status codes (match linear_jax)
 VALID, INVALID, UNKNOWN = 0, 1, 2
+
+
+MAX_TABLE = 4 * N          # successor-table entries the kernel serves
 
 
 class SegKernelSpec(NamedTuple):
@@ -70,6 +75,7 @@ class SegKernelSpec(NamedTuple):
     n_transitions: int
     table_rows: int        # ceil(S*T / LANES)
     chunk: int             # segments per kernel call (SMEM-bounded)
+    table_rows_pad: int    # table buffer rows (bucketed: 8 or 32)
 
 
 def spec_for(n_states: int, n_transitions: int, P: int,
@@ -78,7 +84,7 @@ def spec_for(n_states: int, n_transitions: int, P: int,
     fused kernel (caller falls back to the XLA engines)."""
     if P > ROWS - 1 or K > 8:
         return None
-    if n_states * n_transitions > N:
+    if n_states * n_transitions > MAX_TABLE:
         return None
     slot_bits = max(int(np.ceil(np.log2(max(n_transitions + 2, 2)))), 1)
     state_bits = max(int(np.ceil(np.log2(max(n_states, 2)))), 1)
@@ -92,21 +98,23 @@ def spec_for(n_states: int, n_transitions: int, P: int,
         pos.append((word, shift))
         shift += width
     table_rows = -(-(n_states * n_transitions) // LANES)
+    table_rows_pad = ROWS if table_rows <= ROWS else 4 * ROWS
     # SMEM holds the scalar-prefetch stream: keep chunk * width under
     # ~56KB (measured limit ~60KB on v5e), in multiples of 128
     width = 2 + 2 * K
     chunk = min(CHUNK, (14336 // width) // 128 * 128)
     return SegKernelSpec(P, K, slot_bits, state_bits,
                          tuple(pos[:P]), pos[P],
-                         n_states, n_transitions, table_rows, chunk)
+                         n_states, n_transitions, table_rows, chunk,
+                         table_rows_pad)
 
 
-def pack_table(succ: np.ndarray) -> np.ndarray:
-    """Flatten the successor table into an (8, 128) int32 block
+def pack_table(succ: np.ndarray, rows: int = ROWS) -> np.ndarray:
+    """Flatten the successor table into a (rows, 128) int32 block
     (row-major, padded with -1)."""
-    flat = np.full(N, -1, np.int32)
+    flat = np.full(rows * LANES, -1, np.int32)
     flat[:succ.size] = np.ascontiguousarray(succ, np.int32).reshape(-1)
-    return flat.reshape(ROWS, LANES)
+    return flat.reshape(rows, LANES)
 
 
 def initial_frontier(spec: SegKernelSpec):
@@ -114,16 +122,19 @@ def initial_frontier(spec: SegKernelSpec):
     (all slots idle, state 0), everything else sentinel."""
     hi = np.full((ROWS, LANES), SENT_HI, np.int32)
     lo = np.full((ROWS, LANES), SENT_LO, np.int32)
-    h0 = l0 = 0
-    for q in range(spec.P):
-        w, sh = spec.slot_pos[q]
-        if w == 0:
-            l0 |= 1 << sh          # IDLE = 1
-        else:
-            h0 |= 1 << sh
-    hi[0, 0] = h0
-    lo[0, 0] = l0
+    hi[0, 0], lo[0, 0] = _root_key(spec)
     return hi, lo
+
+
+def _init_stat() -> np.ndarray:
+    """Initial (1, 128) stat row: [status, fail, n, hist-counter] in
+    lanes 0..3 — the layout the kernel's sstat load/flush assumes."""
+    stat0 = np.zeros((1, LANES), np.int32)
+    stat0[0, 0] = VALID
+    stat0[0, 1] = -1
+    stat0[0, 2] = 1
+    stat0[0, 3] = -1
+    return stat0
 
 
 # --- kernel body helpers (traced; all shapes static) ------------------------
@@ -572,7 +583,8 @@ def _chunk_call(spec: SegKernelSpec, b_pad: int = 8):
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((b_pad, LANES), lambda i, *s: (0, 0)),
-            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+            pl.BlockSpec((spec.table_rows_pad, LANES),
+                         lambda i, *s: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
@@ -736,21 +748,27 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
     B = len(segs_list)
     if B == 0:
         return []
+    # the results buffer is VMEM-resident (2 copies: carry in + out);
+    # cap it and run very large batches as consecutive slices — one
+    # extra dispatch per MAX_STREAM_B histories
+    if B > MAX_STREAM_B:
+        out = []
+        for lo_i in range(0, B, MAX_STREAM_B):
+            out.extend(check_device_pallas_stream(
+                succ, segs_list[lo_i:lo_i + MAX_STREAM_B],
+                n_states=n_states, n_transitions=n_transitions, P=P))
+        return out
     b_pad = 8                 # pow2 buckets bound kernel recompiles
     while b_pad < B:
         b_pad *= 2
     chunks, starts = pack_stream(segs_list, spec)
     hi0, lo0 = (jnp.asarray(a) for a in initial_frontier(spec))
-    stat0 = np.zeros((1, LANES), np.int32)
-    stat0[0, 0] = VALID
-    stat0[0, 1] = -1
-    stat0[0, 2] = 1
-    stat0[0, 3] = -1                      # counter: first R -> 0
-    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions]))
+    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions],
+                                   spec.table_rows_pad))
     run = _scan_fn(spec, b_pad=b_pad, stream=True)
     res0 = jnp.zeros((b_pad, LANES), jnp.int32)
     _, _, _, res = run(jnp.asarray(chunks), hi0, lo0,
-                       jnp.asarray(stat0), res0, table)
+                       jnp.asarray(_init_stat()), res0, table)
     res = np.asarray(res)
     out = []
     for b in range(B):
@@ -774,13 +792,10 @@ def _prepare(succ, segs, n_states, n_transitions, P):
         return None
     seg_chunks = pack_segments(segs, spec)
     hi, lo = (jnp.asarray(a) for a in initial_frontier(spec))
-    stat0 = np.zeros((1, LANES), np.int32)
-    stat0[0, 0] = VALID
-    stat0[0, 1] = -1
-    stat0[0, 2] = 1
-    stat0[0, 3] = -1          # history counter (multi-history streams)
-    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions]))
-    return spec, seg_chunks, hi, lo, jnp.asarray(stat0), table
+    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions],
+                                   spec.table_rows_pad))
+    return (spec, seg_chunks, hi, lo, jnp.asarray(_init_stat()),
+            table)
 
 
 def check_device_pallas_chunked(succ: np.ndarray, segs, *,

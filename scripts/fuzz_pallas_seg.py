@@ -83,6 +83,9 @@ def main() -> None:
     c = Counter()
     cases = _cross_model_cases()
     names = [nm for nm, _ in cases]
+    # accumulate per-(bucket, P) groups for the stream-engine stage:
+    # the streamed kernel must reproduce the single-history verdicts
+    stream_groups: dict = {}
     for name, case in cases:
         for seed in range(500, 500 + n):
             rng = random.Random(seed)
@@ -101,6 +104,8 @@ def main() -> None:
                 bucket = (8, 32)
             elif mm.n_states <= 16 and mm.n_transitions <= 64:
                 bucket = (16, 64)
+            elif mm.n_states <= 64 and mm.n_transitions <= 64:
+                bucket = (64, 64)
             else:
                 c[name, "skip"] += 1
                 continue
@@ -127,10 +132,46 @@ def main() -> None:
                 assert n_f == n2, f"{name} seed={seed}: {n_f} vs {n2}"
             c[name, "ok" if st == 0
               else ("inv" if st == 1 else "unk")] += 1
+            stream_groups.setdefault((bucket, P), []).append(
+                (succ, segs, r))
         print(name, {k[1]: v for k, v in c.items() if k[0] == name},
               flush=True)
     assert any(c[nm, "ok"] for nm in names)
     assert any(c[nm, "inv"] for nm in names)
+
+    # --- stream stage: batched verdicts must match single-history ----
+    n_streamed = 0
+    for (bucket, P), group in stream_groups.items():
+        succ = group[0][0]
+        # all entries in a group share the bucketed succ shape, but the
+        # TABLE CONTENTS differ per history's model/memo — a stream
+        # shares one table, so only group histories with identical
+        # tables
+        by_table: dict = {}
+        for succ_g, segs, r in group:
+            by_table.setdefault(succ_g.tobytes(), []).append(
+                (succ_g, segs, r))
+        for sub in by_table.values():
+            if len(sub) < 2:
+                continue
+            succ_g = sub[0][0]
+            segs_list = [s for _, s, _ in sub]
+            rs = PS.check_device_pallas_stream(
+                succ_g, segs_list, n_states=bucket[0],
+                n_transitions=bucket[1], P=P)
+            assert rs is not None
+            for b, (_, segs, want) in enumerate(sub):
+                st, fa, n_f = rs[b]
+                assert st == want[0], \
+                    f"stream b={b}: {rs[b]} vs single {want}"
+                if st == 1:
+                    assert fa == want[1], f"stream fail {fa}!={want[1]}"
+                elif st == 0:
+                    assert n_f == want[2], f"stream n {n_f}!={want[2]}"
+                n_streamed += 1
+    print("stream stage:", n_streamed, "histories cross-checked",
+          flush=True)
+    assert n_streamed > 50
 
 
 if __name__ == "__main__":

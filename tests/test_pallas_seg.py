@@ -22,8 +22,11 @@ from comdb2_tpu.ops.packed import pack_history
 def test_spec_gating():
     s = PS.spec_for(8, 32, 7, 4)
     assert s is not None and s.table_rows == 2
+    assert s.table_rows_pad == 8
+    big = PS.spec_for(64, 64, 2, 4)                  # 4096-entry table
+    assert big is not None and big.table_rows_pad == 32
     assert PS.spec_for(8, 32, 8, 4) is None          # P > 7
-    assert PS.spec_for(64, 64, 2, 4) is None         # table > 1024
+    assert PS.spec_for(128, 64, 2, 4) is None        # table > 4096
     assert PS.spec_for(2, 2, 1, 9) is None           # K > 8
     # key budget: huge transition space overflows the two words
     assert PS.spec_for(8, 1 << 28, 2, 4) is None
@@ -149,6 +152,6 @@ def test_check_device_pallas_none_when_unfit():
     packed = pack_history(h)
     mm = make_memo(M.register(), packed)
     segs = LJ.make_segments(packed)
-    r = PS.check_device_pallas(mm.succ, segs, n_states=64,
+    r = PS.check_device_pallas(mm.succ, segs, n_states=256,
                                n_transitions=64, P=2)
     assert r is None                        # table too large: no fit
