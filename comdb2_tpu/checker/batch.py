@@ -177,22 +177,22 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
             return "flat"
         return "vmap"
 
-    if engine == "auto":
-        if (mesh is None and P_k <= 7
+    def stream_fits():
+        # gate on the spec BEFORE the O(total-ops) segment pass so an
+        # ineligible shape doesn't do the host work twice
+        return (P_k <= 7
                 and PSEG.spec_for(sizes["n_states"],
                                   sizes["n_transitions"], P_k, 8)
-                is not None and PSEG.available()):
+                is not None and PSEG.available())
+
+    if engine == "auto":
+        if mesh is None and stream_fits():
             engine = "stream"
         else:
             engine = pick_xla_engine()
     if engine == "stream":
         rs = None
-        # gate on the spec BEFORE the O(total-ops) segment pass so an
-        # ineligible shape doesn't do the host work twice
-        if (P_k <= 7
-                and PSEG.spec_for(sizes["n_states"],
-                                  sizes["n_transitions"], P_k, 8)
-                is not None and PSEG.available()):
+        if stream_fits():
             segs_list = _stream_segments(batch)
             rs = PSEG.check_device_pallas_stream(
                 batch.memo.succ, segs_list, P=P_k, **sizes)

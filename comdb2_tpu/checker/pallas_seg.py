@@ -225,7 +225,11 @@ def _sort_row(h, l):
     return h, l
 
 
-MINI = 16       # frontier size served by the single-row tier
+def _mini_width(P: int) -> int:
+    """Frontier size served by the single-row tier: the 128 lanes
+    split into P+1 equal groups (frontier + one per candidate chunk) —
+    e.g. 42 configs at P=2, 18 at P=6."""
+    return LANES // (P + 1)
 
 
 def _dedup_count_row(h, l):
@@ -246,15 +250,17 @@ def _dedup_count_row(h, l):
 
 
 def _mini_expand(spec, table, h, l):
-    """Single-row expansion: frontier in lanes 0..MINI-1 of row 0;
-    candidate chunk q lands at lanes [MINI*(q+1), MINI*(q+2)). All
-    rows compute in lockstep; only row 0 is meaningful."""
+    """Single-row expansion: frontier in lanes 0..M-1 of row 0
+    (M = _mini_width(P)); candidate chunk q lands at lanes
+    [M*(q+1), M*(q+2)). All rows compute in lockstep; only row 0 is
+    meaningful."""
     import jax.numpy as jnp
     from jax.experimental.pallas import tpu as pltpu
 
+    M = _mini_width(spec.P)
     _, lane, _ = _iotas()
-    group = lane // MINI
-    fvalid = (h < SENT_HI) & (lane < MINI)
+    group = lane // M
+    fvalid = (h < SENT_HI) & (lane < M)
     s = _field(spec, h, l, spec.state_pos, spec.state_bits)
     out_h, out_l = h, l
     for q in range(spec.P):
@@ -268,8 +274,8 @@ def _mini_expand(spec, table, h, l):
         ch = jnp.where(ok, ch, SENT_HI)
         cl = jnp.where(ok, cl, SENT_LO)
         m = group == q + 1
-        out_h = jnp.where(m, pltpu.roll(ch, MINI * (q + 1), 1), out_h)
-        out_l = jnp.where(m, pltpu.roll(cl, MINI * (q + 1), 1), out_l)
+        out_h = jnp.where(m, pltpu.roll(ch, M * (q + 1), 1), out_h)
+        out_l = jnp.where(m, pltpu.roll(cl, M * (q + 1), 1), out_l)
     pad = group > spec.P           # unused groups when P < 7
     out_h = jnp.where(pad, SENT_HI, out_h)
     out_l = jnp.where(pad, SENT_LO, out_l)
@@ -310,8 +316,9 @@ def _field_add(spec, h, l, pos, delta):
 
 
 def _gather_table(table, idx, table_rows):
-    """table[(8,128)] flat-indexed gather: out[e] = table_flat[idx[e]],
-    idx < table_rows*128. Unrolled row-broadcast + lane gather."""
+    """Flat-indexed gather from a (table_rows_pad, 128) block:
+    out[e] = table_flat[idx[e]], idx < table_rows*128. Unrolled
+    row-broadcast + lane gather."""
     import jax.numpy as jnp
 
     out = jnp.full((ROWS, LANES), -1, jnp.int32)
@@ -486,9 +493,10 @@ def _build_kernel(spec: SegKernelSpec):
                         return eh, el, n2
 
                     def mini(args):
-                        # frontier fits one 16-lane group: the whole
-                        # iteration stays in row 0 and the sorts are
-                        # 28 lane-only stages instead of 55 flat ones
+                        # frontier fits one lane group (128/(P+1)
+                        # lanes): the whole iteration stays in row 0
+                        # and the sorts are 28 lane-only stages
+                        # instead of 55 flat ones
                         ch, cl = args
                         eh, el = _mini_expand(spec, table, ch, cl)
                         eh, el = _sort_row(eh, el)
@@ -498,7 +506,7 @@ def _build_kernel(spec: SegKernelSpec):
                         el = jnp.where(nrow, SENT_LO, el)
                         return eh, el, n2
 
-                    use_mini = sstat[5] <= MINI
+                    use_mini = sstat[5] <= _mini_width(P)
                     eh, el, n2 = lax.cond(use_mini, mini, full,
                                           (ch, cl))
                     ovf = (n2 > F).astype(jnp.int32)

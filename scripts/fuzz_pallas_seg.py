@@ -142,8 +142,7 @@ def main() -> None:
     # --- stream stage: batched verdicts must match single-history ----
     n_streamed = 0
     for (bucket, P), group in stream_groups.items():
-        succ = group[0][0]
-        # all entries in a group share the bucketed succ shape, but the
+        # entries in a group share the bucketed succ shape, but the
         # TABLE CONTENTS differ per history's model/memo — a stream
         # shares one table, so only group histories with identical
         # tables
@@ -171,7 +170,9 @@ def main() -> None:
                 n_streamed += 1
     print("stream stage:", n_streamed, "histories cross-checked",
           flush=True)
-    assert n_streamed > 50
+    # the coverage floor scales with the requested seed count (small
+    # runs legitimately form few shared-table groups)
+    assert n_streamed > n // 3
 
 
 if __name__ == "__main__":
