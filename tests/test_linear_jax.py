@@ -249,6 +249,27 @@ def test_pack_words_injective_when_fits():
         assert len(pairs) == len(configs), (n_states, n_tr, P)
 
 
+def test_malformed_history_isolated_in_batch():
+    """A double-pending history (bypassing history.complete) must come
+    back `unknown` without poisoning the rest of the batch — the
+    check-safe semantics of checker.clj:54-64 applied per key."""
+    from comdb2_tpu.checker.batch import pack_batch, check_batch
+    from comdb2_tpu.ops import op as O
+
+    good = [O.invoke(0, "write", 1), O.ok(0, "write", 1)]
+    bad = [O.invoke(0, "write", 1), O.invoke(0, "write", 2),
+           O.ok(0, "write", 2)]
+    # build the malformed history by hand: complete() would raise
+    bad = [op.with_(index=i) for i, op in enumerate(bad)]
+    packed_bad = pack_history(bad, completed=True)
+    batch = pack_batch([good, packed_bad, good], M.cas_register())
+    for engine in ("keys", "flat", "vmap"):
+        status, fail_at, n = check_batch(batch, F=32, engine=engine)
+        assert status[0] == LJ.VALID and status[2] == LJ.VALID
+        assert status[1] == LJ.UNKNOWN, (engine, status)
+        assert fail_at[1] == -1 and n[1] == 0
+
+
 def test_keys_engine_matches_host_fuzz():
     from comdb2_tpu.checker.batch import pack_batch, check_batch
 
@@ -286,8 +307,46 @@ def test_device_batch_sharded_mesh():
                                                   n_events=10))
     batch = pack_batch(histories, model)
     mesh = Mesh(np.array(jax.devices()), ("batch",))
-    status, fail_at, n = check_batch(batch, F=64, mesh=mesh)
+    info = {}
+    status, fail_at, n = check_batch(batch, F=64, mesh=mesh, info=info)
     assert all(s == LJ.VALID for s in status)
+    # the mesh path must ride a fast engine, not the 20x-slower vmap
+    # fallback (round-1 Weak #2)
+    assert info["engine"] == "keys-sharded", info
+
+
+def test_sharded_engines_match_solo():
+    """Sharded keys/flat runs (8-device CPU mesh, B not divisible by
+    the axis) must return the same verdicts and fail indices as the
+    single-device engines on mixed valid/invalid/info histories."""
+    import jax
+    from jax.sharding import Mesh
+    from comdb2_tpu.checker.batch import pack_batch, check_batch
+
+    model = M.cas_register()
+    histories = []
+    for seed in range(13):          # 13 % 8 != 0: exercises padding
+        rng = random.Random(72_000 + seed)
+        h = histgen.register_history(
+            rng, n_procs=rng.randint(2, 4),
+            n_events=rng.randint(6, 28),
+            p_info=0.1 if seed % 3 == 0 else 0.0)
+        if seed % 2:
+            h = histgen.mutate(rng, h)
+        histories.append(h)
+    batch = pack_batch(histories, model)
+    mesh = Mesh(np.array(jax.devices()), ("batch",))
+    solo_status, solo_fail, solo_n = check_batch(batch, F=64,
+                                                 engine="keys")
+    for engine in ("keys", "flat"):
+        info = {}
+        status, fail_at, n = check_batch(batch, F=64, mesh=mesh,
+                                         engine=engine, info=info)
+        assert info["engine"] == f"{engine}-sharded", info
+        assert status.shape == (13,)
+        assert list(status) == list(solo_status), (engine, status)
+        assert list(fail_at) == list(solo_fail), (engine, fail_at)
+        assert list(n) == list(solo_n), (engine, n)
 
 
 def test_dedup_survives_sentinel_collisions():

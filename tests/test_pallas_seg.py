@@ -132,7 +132,8 @@ def test_check_batch_stream_unknown_escalates(monkeypatch):
     batch = B.pack_batch(hs, M.cas_register())
     want = B.check_batch(batch, engine="keys")
 
-    def fake_stream(succ, segs_list, *, n_states, n_transitions, P):
+    def fake_stream(succ, segs_list, *, n_states, n_transitions, P,
+                    devices=None):
         # history 2 pretends to overflow the kernel frontier
         out = []
         for b in range(len(segs_list)):
