@@ -50,18 +50,28 @@ def _malformed(p: PackedHistory) -> bool:
 
     Vectorized: group invoke/completion events per process (stable
     sort); two adjacent invokes within one process's subsequence mean
-    a double-pending invocation."""
+    a double-pending invocation. Cached per PackedHistory — check_batch
+    and its segment helpers each consult it."""
+    cached = getattr(p, "_malformed_cache", None)
+    if cached is not None:
+        return cached
     t = np.asarray(p.type)
     inv = (t == INVOKE) & ~np.asarray(p.fails)
     sel = inv | (t == OK) | (t == FAIL)
     if not sel.any():
-        return False
-    procs = np.asarray(p.process)[sel]
-    isinv = inv[sel]
-    order = np.argsort(procs, kind="stable")
-    ps, iv = procs[order], isinv[order]
-    same = ps[1:] == ps[:-1]
-    return bool(np.any(same & iv[1:] & iv[:-1]))
+        out = False
+    else:
+        procs = np.asarray(p.process)[sel]
+        isinv = inv[sel]
+        order = np.argsort(procs, kind="stable")
+        ps, iv = procs[order], isinv[order]
+        same = ps[1:] == ps[:-1]
+        out = bool(np.any(same & iv[1:] & iv[:-1]))
+    try:
+        p._malformed_cache = out
+    except AttributeError:
+        pass                      # slotted/frozen variants: recompute
+    return out
 
 
 def _empty_stream():

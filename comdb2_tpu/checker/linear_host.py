@@ -63,6 +63,9 @@ class HostResult:
     configs: List[Config] = field(default_factory=list)  # frontier sample
     final_count: int = 0
     max_frontier: int = 0               # peak |configs| over the run
+    # on failure: the frontier JUST BEFORE the dying ok's closure — the
+    # seeds for final-path reconstruction (``linear.clj:180-212``)
+    pre_configs: List[Config] = field(default_factory=list)
 
 
 def closure(configs: Set[Config], succ,
@@ -92,15 +95,22 @@ def closure(configs: Set[Config], succ,
 
 
 def check(memo: MemoizedModel, packed: PackedHistory,
-          max_configs: int = 1 << 22) -> HostResult:
+          max_configs: int = 1 << 22, start_index: int = 0,
+          init_configs: Optional[Set[Config]] = None) -> HostResult:
     """Run the search over a packed history. Raises
     :class:`FrontierOverflow` if the config set ever exceeds
-    ``max_configs``."""
+    ``max_configs``.
+
+    ``start_index``/``init_configs`` resume the search mid-history from
+    a known frontier (e.g. a device scan's chunk-boundary carry) — the
+    bounded counterexample-reconstruction path replays at most one
+    chunk on host instead of the whole history."""
     P = len(packed.process_table)
     succ = memo.succ
-    configs: Set[Config] = {(0, (IDLE,) * P)}
-    peak = 1
-    for i in range(len(packed)):
+    configs: Set[Config] = (set(init_configs) if init_configs is not None
+                            else {(0, (IDLE,) * P)})
+    peak = len(configs)
+    for i in range(start_index, len(packed)):
         t = int(packed.type[i])
         if t == INVOKE:
             if packed.fails[i]:
@@ -111,6 +121,7 @@ def check(memo: MemoizedModel, packed: PackedHistory,
                        for (s, slots) in configs}
         elif t == OK:
             p = int(packed.process[i])
+            pre = configs
             closed = closure(configs, succ, max_configs)
             peak = max(peak, len(closed))
             configs = {(s, slots[:p] + (IDLE,) + slots[p + 1:])
@@ -118,7 +129,8 @@ def check(memo: MemoizedModel, packed: PackedHistory,
             if not configs:
                 return HostResult(valid=False, op_index=i,
                                   configs=sorted(closed)[:16],
-                                  final_count=0, max_frontier=peak)
+                                  final_count=0, max_frontier=peak,
+                                  pre_configs=sorted(pre)[:16])
         # fail / info: no-op
     return HostResult(valid=True, final_count=len(configs),
                       configs=sorted(configs)[:16], max_frontier=peak)

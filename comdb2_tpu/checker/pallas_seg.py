@@ -851,10 +851,17 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
                                 n_states: int, n_transitions: int,
                                 P: int, progress=None,
                                 progress_interval_s: float = 5.0,
-                                s_real: Optional[int] = None):
+                                s_real: Optional[int] = None,
+                                return_boundary: bool = False):
     """Chunk-at-a-time variant: returns to the host between kernel
     calls so ``progress(done, total, frontier_n)`` can fire (the
-    reference's 5-second reporter cadence, ``linear.clj:273-297``)."""
+    reference's 5-second reporter cadence, ``linear.clj:273-297``).
+
+    With ``return_boundary`` the result gains a 4th element
+    ``(hi, lo, done)``: the packed frontier at the last chunk boundary
+    BEFORE the failure and the number of segments consumed up to it —
+    the seed for bounded counterexample reconstruction (decode with
+    :func:`decode_frontier`)."""
     import time
 
     import jax.numpy as jnp
@@ -867,21 +874,50 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     res = jnp.zeros((8, LANES), jnp.int32)       # unused: no RESETs
     s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
     last = time.monotonic()
+    prev_hi, prev_lo, done = hi, lo, 0
     for c in range(seg_chunks.shape[0]):
         off = np.array([c * spec.chunk, n_transitions], np.int32)
         hi, lo, stat, res = call(jnp.asarray(seg_chunks[c]),
-                                 jnp.asarray(off), hi, lo, stat, res,
-                                 table)
+                                 jnp.asarray(off), hi, lo,
+                                 stat, res, table)
         st = np.asarray(stat)
         if int(st[0, 0]) != VALID:
             break
+        prev_hi, prev_lo, done = hi, lo, (c + 1) * spec.chunk
         now = time.monotonic()
         if progress is not None and now - last >= progress_interval_s:
             progress(min((c + 1) * spec.chunk, s_real), s_real,
                      int(st[0, 2]))
             last = now
     st = np.asarray(stat)
-    return int(st[0, 0]), int(st[0, 1]), int(st[0, 2])
+    out = (int(st[0, 0]), int(st[0, 1]), int(st[0, 2]))
+    if return_boundary:
+        return out + ((np.asarray(prev_hi), np.asarray(prev_lo),
+                       min(done, s_real)),)
+    return out
+
+
+def decode_frontier(spec: SegKernelSpec, hi: np.ndarray,
+                    lo: np.ndarray, P: int):
+    """Decode a kernel frontier (packed keys, row 0) into host configs
+    ``(state, slots)`` in the :mod:`~.linear_host` encoding: the slot
+    field stores LIN=0 / IDLE=1 / tr+2, so subtracting 2 maps straight
+    to LIN=-2 / IDLE=-1 / tr. Padding slots beyond ``P`` are dropped
+    (always IDLE)."""
+    def field(pos, bits):
+        word, sh = pos
+        src = lo[0] if word == 0 else hi[0]
+        return (src >> sh) & ((1 << bits) - 1)
+
+    state = field(spec.state_pos, spec.state_bits)
+    slots = [field(spec.slot_pos[q], spec.slot_bits)
+             for q in range(min(P, spec.P))]
+    out = set()
+    for lane in np.flatnonzero(hi[0] < SENT_HI):
+        out.add((int(state[lane]),
+                 tuple(int(slots[q][lane]) - 2
+                       for q in range(min(P, spec.P)))))
+    return out
 
 
 @functools.lru_cache(maxsize=1)

@@ -49,7 +49,10 @@ def render_analysis(history: Sequence[Op], analysis,
 
     width, left = 980, 90
     lane = (width - left - 240) / n
-    height = 60 + ROW_H * max(len(procs), 1) + 16 * 12
+    path_lines = _layout_paths(list(_paths_of(analysis))[:8],
+                               left, width - 30)
+    height = (60 + ROW_H * max(len(procs), 1) + 16 * 12
+              + (40 + 18 * len(path_lines) if path_lines else 0))
     svg = SVG(width, int(height))
     svg.text(width / 2, 16, "linearizability counterexample", size=13,
              anchor="middle")
@@ -90,9 +93,81 @@ def render_analysis(history: Sequence[Op], analysis,
     if not configs:
         svg.text(left, y + 14, "  (none recorded)", size=9, fill="#444")
 
+    # --- failed linearization orders (final paths) -------------------
+    # the role of the reference's model-transition rendering
+    # (knossos/linear/report.clj:385,629): each path is a chain of
+    # op -> resulting-state chips ending where the model went
+    # inconsistent; long chains wrap so the dying (red) step is never
+    # clipped off-canvas
+    if path_lines:
+        y += 20 + 13 * max(len(configs), 1)
+        svg.text(left, y, "failed linearization orders "
+                          "(each order dies at the red step):",
+                 size=10)
+        y += 8
+        for li, line in enumerate(path_lines):
+            py = y + 18 * (li + 1)
+            for (x, w, label, dead, arrow, title) in line:
+                svg.rect(x, py - 11, w, 15,
+                         fill="#FFD4D5" if dead else "#EDF3FF",
+                         stroke="#c0392b" if dead else "#aab",
+                         title=title)
+                svg.text(x + 3, py, label, size=9,
+                         fill="#c0392b" if dead else "#223")
+                if arrow:
+                    svg.line(x + w + 2, py - 4, x + w + 11, py - 4,
+                             stroke="#888")
+
     out = svg.render()
     if path:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as fh:
             fh.write(out)
     return out
+
+
+def _paths_of(analysis):
+    """Final paths from an Analysis (info dict) or a plain mapping."""
+    info = getattr(analysis, "info", None)
+    if isinstance(info, dict) and info.get("paths"):
+        return info["paths"]
+    if isinstance(analysis, dict):
+        return analysis.get("paths", [])
+    return getattr(analysis, "paths", []) or []
+
+
+def _layout_paths(paths, left: float, right: float):
+    """Pre-layout path chips into wrapped display lines. Each line is a
+    list of (x, w, label, dead, draw_arrow, title) chips; a path whose
+    chips exceed the canvas width continues (indented) on the next
+    line."""
+    lines = []
+    for p in paths:
+        line = []
+        x = left
+        for si, step in enumerate(p):
+            op_d = step.get("op")
+            model = step.get("model")
+            dead = model == "inconsistent"
+            label = _step_label(op_d, model)
+            w = 7 + 5.2 * len(label)
+            if x + w > right and line:      # wrap; keep chip intact
+                lines.append(line)
+                line = []
+                x = left + 24
+            arrow = si < len(p) - 1
+            line.append((x, w, label, dead, arrow,
+                         f"{op_d!r} -> {model!r}"))
+            x += w + 14
+        if line:
+            lines.append(line)
+    return lines
+
+
+def _step_label(op_d, model) -> str:
+    if isinstance(op_d, dict):
+        op_s = f"{op_d.get('f')} {op_d.get('value')!r}"
+    else:
+        op_s = str(op_d)
+    m_s = "⊥" if model == "inconsistent" else str(model)
+    return f"{op_s} → {m_s}"[:46]
