@@ -70,7 +70,6 @@ def reconstruct(mm: MemoizedModel, packed: PackedHistory,
     the failure (e.g. the verdict came from a different engine setup).
     """
     from . import linear_jax as LJ
-    from . import pallas_seg as PSEG
 
     P = len(packed.process_table)
     P2 = max(P + (P & 1), 2)

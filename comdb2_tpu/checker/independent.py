@@ -74,7 +74,13 @@ class IndependentChecker(Checker):
     def check(self, test, model, history, opts=None):
         ks = history_keys(history)
         subs = {k: subhistory(k, history) for k in ks}
-        if isinstance(self.base, Linearizable) and len(ks) > 1:
+        # honor an explicit host backend: fault-heavy harness histories
+        # have retirement-inflated process counts whose one-off device
+        # shapes cost minutes of compile for milliseconds of work
+        device_ok = not (isinstance(self.base, Linearizable)
+                         and getattr(self.base, "backend", None) == "host")
+        if isinstance(self.base, Linearizable) and len(ks) > 1 \
+                and device_ok:
             results = self._check_linearizable_batch(model, subs)
         else:
             results = {k: check_safe(self.base, test, model, subs[k], opts)

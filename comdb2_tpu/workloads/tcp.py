@@ -116,6 +116,236 @@ class TcpRegisterClient(client_ns.Client):
             return {**op, "type": "info", "error": str(e)}
 
 
+class TcpClusterRegisterClient(TcpRegisterClient):
+    """Register client against a replicated ``sut_node`` cluster: each
+    worker talks to one node (cycled), so reads land on replicas and a
+    partition between nodes is visible to the checker — the client-side
+    shape of the reference's 5-node register test
+    (``comdb2/core.clj:567-613``)."""
+
+    def __init__(self, ports, timeout_s: float = 1.0):
+        super().__init__("127.0.0.1", ports[0], timeout_s)
+        self.ports = list(ports)
+        self._next = 0
+
+    def setup(self, test, node):
+        port = self.ports[self._next % len(self.ports)]
+        self._next += 1
+        c = TcpClusterRegisterClient(self.ports, self.timeout_s)
+        c.conn = SutConnection(self.host, port, self.timeout_s)
+        c.conn.connect()
+        return c
+
+    def invoke(self, test, op):
+        """Keyed commands (``R k`` / ``W k v`` / ``C k a b``): the
+        cluster stores one register per key like the reference's
+        register table, and the independent checker verifies per key."""
+        f = op["f"]
+        k, v = op["value"] if op["value"] is not None else (1, None)
+        try:
+            if f == "read":
+                # reads have no side effects, so any failure is safely
+                # :fail (never pends) — an info read would stay pending
+                # forever and pending ops are what blow up the checker
+                try:
+                    reply = self.conn.request(f"R {k}")
+                except TimeoutError:
+                    return {**op, "type": "fail"}
+                if reply == "NIL":
+                    return {**op, "type": "ok", "value": tuple_(k, None)}
+                if reply.startswith("V "):
+                    return {**op, "type": "ok",
+                            "value": tuple_(k, int(reply[2:]))}
+                return {**op, "type": "fail"}
+            if f == "write":
+                reply = self.conn.request(f"W {k} {v}")
+            elif f == "cas":
+                a, b = v
+                reply = self.conn.request(f"C {k} {a} {b}")
+            else:
+                raise ValueError(f"unknown f {f!r}")
+            if reply == "OK":
+                return {**op, "type": "ok"}
+            if reply == "FAIL":
+                return {**op, "type": "fail"}
+            return {**op, "type": "info", "error": reply}
+        except TimeoutError as e:
+            return {**op, "type": "info", "error": str(e)}
+
+
+class ClusterControl:
+    """Admin-plane driver for a ``sut_node`` cluster: cluster/primary
+    discovery (the ``cdb2_cluster_info`` / ``sys.cmd.send('bdb
+    cluster')`` role, ``ctest/nemesis.c:15-47``) and symmetric
+    partitions over the B/U control verbs."""
+
+    def __init__(self, ports, timeout_s: float = 2.0):
+        self.ports = list(ports)
+        self.timeout_s = timeout_s
+
+    def _req(self, port: int, line: str) -> str:
+        conn = SutConnection("127.0.0.1", port, self.timeout_s)
+        try:
+            conn.connect()
+            return conn.request(line)
+        finally:
+            conn.close()
+
+    def info(self):
+        """[{node, role, applied, durable}] for reachable nodes;
+        ``durable`` is meaningful on the primary only."""
+        out = []
+        for i, port in enumerate(self.ports):
+            try:
+                r = self._req(port, "I").split()
+                out.append({"node": int(r[1]), "role": r[2],
+                            "applied": int(r[3]), "durable": int(r[4]),
+                            "port": port})
+            except (TimeoutError, OSError, IndexError, ValueError):
+                out.append({"node": i, "role": "down", "port": port})
+        return out
+
+    def primary(self):
+        """Discovered primary node id, or None."""
+        for n in self.info():
+            if n["role"] == "primary":
+                return n["node"]
+        return None
+
+    def partition(self, side_a, side_b) -> None:
+        """Symmetric partition: every node in side_a drops traffic with
+        every node in side_b and vice versa (the grudge map shape of
+        ``nemesis.clj:21-27``). Best-effort like the iptables nemesis:
+        an unreachable node's verbs are skipped rather than aborting
+        half-installed."""
+        for a in side_a:
+            for b in side_b:
+                for port, peer in ((self.ports[a], b),
+                                   (self.ports[b], a)):
+                    try:
+                        self._req(port, f"B {peer}")
+                    except (TimeoutError, OSError):
+                        pass
+
+    def heal(self) -> None:
+        for port in self.ports:
+            try:
+                self._req(port, "U")
+            except (TimeoutError, OSError):
+                pass
+
+    def await_replicated(self, timeout_s: float = 10.0) -> bool:
+        """Coherency gate: wait until every node's applied LSN matches
+        the primary's (the ``blockcoherent.sh:15-37`` role)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            info = self.info()
+            applied = [n.get("applied") for n in info]
+            if all(a is not None for a in applied) and \
+                    len(set(applied)) == 1:
+                return True
+            _time.sleep(0.1)
+        return False
+
+
+class ClusterPartitioner:
+    """Nemesis client: on ``start`` discovers the primary and cuts
+    {primary, one random other node} off from the rest — the
+    highest-yield fault of the reference suite (``nemesis.c:90-144``
+    breaknet targets master+1); on ``stop`` heals."""
+
+    def __init__(self, control: ClusterControl, rng=None,
+                 isolate_primary: bool = False):
+        """``isolate_primary`` cuts the primary ALONE from everyone —
+        in an N=3 cluster the breaknet shape {master, +1} keeps a
+        majority on the master's side, so isolating the primary is the
+        variant that actually denies it quorum."""
+        import random as _random
+
+        self.control = control
+        self.rng = rng or _random.Random(0)
+        self.isolate_primary = isolate_primary
+
+    def setup(self, test, node):
+        return self
+
+    def teardown(self, test):
+        self.control.heal()
+
+    def invoke(self, test, op):
+        n = len(self.control.ports)
+        if op["f"] == "start":
+            primary = self.control.primary()
+            if primary is None:
+                primary = 0
+            others = [i for i in range(n) if i != primary]
+            extra = ([] if self.isolate_primary or len(others) <= 1
+                     else [self.rng.choice(others)])
+            side_a = [primary] + extra
+            side_b = [i for i in range(n) if i not in side_a]
+            self.control.partition(side_a, side_b)
+            return {**op, "value": f"cut {side_a} from {side_b}"}
+        self.control.heal()
+        return dict(op)
+
+
+def spawn_cluster(binary: str, ports, durable: bool = True,
+                  timeout_ms: int = 2000, wait_s: float = 5.0):
+    """Start one ``sut_node`` per port on localhost; returns the list
+    of processes once every node answers PING."""
+    import subprocess
+    import time
+
+    plist = ",".join(str(p) for p in ports)
+    procs = []
+    for i in range(len(ports)):
+        args = [binary, "-i", str(i), "-n", plist,
+                "-t", str(timeout_ms)]
+        if not durable:
+            args.append("-N")
+        procs.append(subprocess.Popen(args,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.DEVNULL))
+    def kill_all():
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+
+    deadline = time.monotonic() + wait_s
+    try:
+        for i, port in enumerate(ports):
+            _wait_ready(procs[i], port, deadline, "sut_node")
+    except RuntimeError:
+        kill_all()
+        raise
+    return procs
+
+
+def _wait_ready(proc, port: int, deadline: float, name: str) -> None:
+    """Poll until the server answers PING, it dies, or the deadline
+    passes (shared by spawn_server/spawn_cluster)."""
+    import time
+
+    conn = SutConnection("127.0.0.1", port, timeout_s=0.3)
+    while True:
+        rc = proc.poll()
+        if rc is not None:          # died at startup (port taken, …)
+            raise RuntimeError(
+                f"{name} on port {port} exited rc={rc} at startup")
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"{name} on port {port} never became ready")
+        try:
+            conn.connect()
+            if conn.request("P") == "PONG":
+                conn.close()
+                return
+        except (OSError, TimeoutError):
+            time.sleep(0.05)
+
+
 def spawn_server(binary: str, port: int, *flags: str,
                  wait_s: float = 5.0) -> "subprocess.Popen":
     """Start a local sut_server and wait until it answers PING."""
@@ -125,19 +355,10 @@ def spawn_server(binary: str, port: int, *flags: str,
     proc = subprocess.Popen([binary, "-p", str(port), *flags],
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
-    deadline = time.monotonic() + wait_s
-    conn = SutConnection("127.0.0.1", port, timeout_s=0.3)
-    while time.monotonic() < deadline:
-        rc = proc.poll()
-        if rc is not None:      # died at startup (bad port/flags)
-            raise RuntimeError(
-                f"sut_server on port {port} exited rc={rc} at startup")
-        try:
-            conn.connect()
-            if conn.request("P") == "PONG":
-                conn.close()
-                return proc
-        except (OSError, TimeoutError):
-            time.sleep(0.05)
-    proc.kill()
-    raise RuntimeError(f"sut_server on port {port} never became ready")
+    try:
+        _wait_ready(proc, port, time.monotonic() + wait_s, "sut_server")
+    except RuntimeError:
+        proc.kill()
+        proc.wait()
+        raise
+    return proc

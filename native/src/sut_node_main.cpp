@@ -1,0 +1,467 @@
+/* sut_node — one node of a replicated register/set SUT cluster.
+ *
+ * The in-tree stand-in for the reference's 5-node comdb2 cluster in its
+ * linearizable configuration (linearizable/linearizable.lrl:1-17):
+ * a primary ships a totally-ordered op log to replicas and, in durable
+ * mode, acknowledges a write only after a MAJORITY of nodes hold it —
+ * the durable-LSN rule of bdb/rep.c:2096 ("client writes aren't done
+ * until a majority has them"). `--no-durable` is the negative control:
+ * writes are acknowledged after the local apply only, so a partition
+ * between primary and replicas yields real stale reads / lost writes
+ * that the checker must catch (round-1 Missing #3: partitions could
+ * sever client<->server but never produce an anomaly in-tree).
+ *
+ * Topology: all nodes on 127.0.0.1, one port each; node 0 is primary
+ * (static — no election; a partitioned durable primary blocks, which is
+ * the honest linearizable behavior without leader change).
+ *
+ * Client protocol (line-based, same shapes as sut_server):
+ *   R [k]      -> "V <int>" | "NIL" | "UNKNOWN"   read key k (dflt 1)
+ *   W [k] <v>  -> "OK" | "UNKNOWN"                write
+ *   C [k] <a> <b> -> "OK" | "FAIL" | "UNKNOWN"    cas
+ *   A <v>      -> "OK" | "UNKNOWN"                set add
+ *   S          -> "V <v1> ..."                    set read (local)
+ *   P          -> "PONG"
+ *   I          -> "I <id> <role> <applied> <durable>"  cluster info
+ *                 (role: primary|replica; <durable> is meaningful on
+ *                 the primary only — replicas always report 0)
+ *   B <peer>   -> "OK"   drop traffic with node <peer>  (partition)
+ *   U <peer>   -> "OK"   heal one peer
+ *   U          -> "OK"   heal all
+ * Inter-node:
+ *   F <from> <cmd...>    forwarded client op (dropped when blocked)
+ *   E <from> <lsn> <op...> -> "A <lsn>"        log entry (repl stream)
+ *
+ * Reads in durable mode forward to the primary (the role of
+ * REQUEST_DURABLE_LSN_FROM_MASTER / RETRIEVE_DURABLE_LSN_AT_BEGIN in
+ * the lrl); in no-durable mode every node serves its possibly-stale
+ * local state.
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct LogEntry {
+    char kind;          /* 'W', 'C', 'A' */
+    long long key, a, b;    /* register key (the jepsen register id) */
+};
+
+struct Node {
+    int id = 0;
+    int primary = 0;
+    bool durable = true;
+    int timeout_ms = 2000;      /* durable-LSN wait (lrl:17 = 2000ms) */
+    std::vector<int> ports;
+
+    std::mutex mu;
+    std::condition_variable cv;
+
+    /* replicated state machine (applied prefix of the log): keyed
+     * registers (the reference's register table rows, id -> val) */
+    long long applied_lsn = 0;
+    std::map<long long, long long> regs;
+    std::vector<long long> set_vals;
+
+    /* primary-only: the log + per-replica ack tracking */
+    std::vector<LogEntry> log;               /* log[i] has lsn i+1 */
+    std::vector<long long> acked_upto;       /* per node id */
+    long long durable_lsn = 0;
+
+    /* partition control: peers we drop traffic with */
+    std::set<int> blocked;
+
+    bool is_primary() const { return id == primary; }
+    size_t majority() const { return ports.size() / 2 + 1; }
+
+    bool blocked_peer(int peer) {
+        std::lock_guard<std::mutex> g(mu);
+        return blocked.count(peer) != 0;
+    }
+
+    /* apply an entry to the local state machine; caller holds mu */
+    void apply_locked(const LogEntry &e) {
+        if (e.kind == 'W') {
+            regs[e.key] = e.a;
+        } else if (e.kind == 'C') {
+            /* CAS entries are logged only when they applied */
+            regs[e.key] = e.b;
+        } else if (e.kind == 'A') {
+            set_vals.push_back(e.a);
+        }
+        applied_lsn++;
+    }
+
+    void recompute_durable_locked() {
+        /* durable LSN = highest lsn held by a majority (self included):
+         * sort per-node acked positions, take the majority-th highest —
+         * the durable-LSN calculation of bdb/rep.c:2096 */
+        std::vector<long long> pos = acked_upto;
+        pos[id] = (long long)log.size();
+        std::sort(pos.begin(), pos.end(), std::greater<long long>());
+        long long d = pos[majority() - 1];
+        if (d > durable_lsn) {
+            durable_lsn = d;
+            cv.notify_all();
+        }
+    }
+};
+
+Node g_node;
+
+/* ---------- small line-protocol client (for forwarding) ----------- */
+
+int dial(int port, int timeout_ms) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)port);
+    if (connect(fd, (sockaddr *)&addr, sizeof addr) != 0) {
+        close(fd);
+        return -1;
+    }
+    return fd;
+}
+
+bool send_all(int fd, const std::string &s) {
+    size_t off = 0;
+    while (off < s.size()) {
+        ssize_t w = write(fd, s.c_str() + off, s.size() - off);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        off += (size_t)w;
+    }
+    return true;
+}
+
+/* read one '\n'-terminated line (without the newline); false on
+ * timeout/eof */
+bool read_line(int fd, std::string *out) {
+    out->clear();
+    char c;
+    for (;;) {
+        ssize_t r = read(fd, &c, 1);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return false;
+        }
+        if (c == '\n') return true;
+        out->push_back(c);
+        if (out->size() > 4096) return false;
+    }
+}
+
+/* one transient request/reply to a peer; empty string = no answer */
+std::string peer_request(int port, const std::string &line,
+                         int timeout_ms) {
+    int fd = dial(port, timeout_ms);
+    if (fd < 0) return "";
+    std::string reply;
+    if (!send_all(fd, line + "\n") || !read_line(fd, &reply))
+        reply.clear();
+    close(fd);
+    return reply;
+}
+
+/* ---------- replication sender (primary -> one replica) ----------- */
+
+void sender_thread(int peer) {
+    Node &n = g_node;
+    int fd = -1;
+    for (;;) {
+        long long next;
+        LogEntry e{};
+        {
+            std::unique_lock<std::mutex> lk(n.mu);
+            n.cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
+                return n.acked_upto[peer] < (long long)n.log.size() &&
+                       n.blocked.count(peer) == 0;
+            });
+            if (n.blocked.count(peer) != 0 ||
+                n.acked_upto[peer] >= (long long)n.log.size())
+                continue;
+            next = n.acked_upto[peer] + 1;
+            e = n.log[(size_t)next - 1];
+        }
+        if (fd < 0) fd = dial(n.ports[peer], 200);
+        if (fd < 0) {
+            /* unreachable replica: back off instead of spinning the
+             * dial loop at 100% CPU (loopback refusals fail in µs) */
+            std::this_thread::sleep_for(std::chrono::milliseconds(50));
+            continue;
+        }
+        char buf[160];
+        snprintf(buf, sizeof buf, "E %d %lld %c %lld %lld %lld\n",
+                 n.id, next, e.kind, e.key, e.a, e.b);
+        std::string reply;
+        if (!send_all(fd, buf) || !read_line(fd, &reply)) {
+            close(fd);
+            fd = -1;
+            continue;
+        }
+        long long acked = 0;
+        if (sscanf(reply.c_str(), "A %lld", &acked) == 1) {
+            std::lock_guard<std::mutex> g(n.mu);
+            if (acked > n.acked_upto[peer]) {
+                n.acked_upto[peer] = acked;
+                n.recompute_durable_locked();
+            }
+        } else {
+            close(fd);
+            fd = -1;
+        }
+    }
+}
+
+/* ---------- request handling -------------------------------------- */
+
+/* primary-side commit: append + apply + (durable) wait for majority.
+ * Returns "OK", "FAIL" (cas precondition), or "UNKNOWN" (durable wait
+ * timed out: the op is in the log and may still replicate —
+ * indeterminate, exactly an :info op). The cas precondition is decided
+ * under the same lock as the append, so concurrent cas ops serialize. */
+std::string primary_commit(const LogEntry &e, bool is_cas = false) {
+    Node &n = g_node;
+    long long lsn;
+    {
+        std::lock_guard<std::mutex> g(n.mu);
+        if (is_cas) {
+            auto it = n.regs.find(e.key);
+            if (it == n.regs.end() || it->second != e.a)
+                return "FAIL";
+        }
+        n.log.push_back(e);
+        lsn = (long long)n.log.size();
+        n.apply_locked(e);
+        n.recompute_durable_locked();
+    }
+    n.cv.notify_all();
+    if (!n.durable) return "OK";
+    std::unique_lock<std::mutex> lk(n.mu);
+    bool ok = n.cv.wait_for(lk, std::chrono::milliseconds(n.timeout_ms),
+                            [&] { return n.durable_lsn >= lsn; });
+    return ok ? "OK" : "UNKNOWN";
+}
+
+std::string handle(const std::string &line);
+
+/* forward a client op to the primary; both the partition state of this
+ * node and the primary's are honored (F carries the origin id). A
+ * blocked link behaves like a real partition: the request HANGS until
+ * the timeout instead of failing fast — an instant UNKNOWN would let
+ * clients machine-gun indeterminate ops (hundreds of forever-pending
+ * ops per window make verification itself intractable; real packet
+ * drops throttle clients to their timeout cadence). */
+std::string forward_to_primary(const std::string &cmd) {
+    Node &n = g_node;
+    if (n.blocked_peer(n.primary)) {
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(n.timeout_ms));
+        return "UNKNOWN";
+    }
+    char buf[160];
+    snprintf(buf, sizeof buf, "F %d %s", n.id, cmd.c_str());
+    std::string r = peer_request(n.ports[n.primary], buf, n.timeout_ms);
+    return r.empty() ? "UNKNOWN" : r;
+}
+
+std::string handle(const std::string &line) {
+    Node &n = g_node;
+    char cmd = line.empty() ? 0 : line[0];
+    if (cmd == 'P') return "PONG";
+    if (cmd == 'I') {
+        std::lock_guard<std::mutex> g(n.mu);
+        char buf[128];
+        snprintf(buf, sizeof buf, "I %d %s %lld %lld", n.id,
+                 n.is_primary() ? "primary" : "replica", n.applied_lsn,
+                 n.durable_lsn);
+        return buf;
+    }
+    if (cmd == 'B' || cmd == 'U') {
+        int peer = -1;
+        bool have = sscanf(line.c_str() + 1, "%d", &peer) == 1;
+        std::lock_guard<std::mutex> g(n.mu);
+        if (cmd == 'B' && have)
+            n.blocked.insert(peer);
+        else if (cmd == 'U' && have)
+            n.blocked.erase(peer);
+        else if (cmd == 'U')
+            n.blocked.clear();
+        n.cv.notify_all();
+        return "OK";
+    }
+    if (cmd == 'F') {
+        int from = -1;
+        int off = 0;
+        if (sscanf(line.c_str() + 1, "%d %n", &from, &off) < 1)
+            return "ERR";
+        if (n.blocked_peer(from)) {     /* hang like a dropped packet */
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(n.timeout_ms));
+            return "UNKNOWN";
+        }
+        return handle(line.substr(1 + (size_t)off));
+    }
+    if (cmd == 'E') {
+        int from = -1;
+        long long lsn = 0, key = 0, a = 0, b = 0;
+        char kind = 0;
+        if (sscanf(line.c_str() + 1, "%d %lld %c %lld %lld %lld",
+                   &from, &lsn, &kind, &key, &a, &b) != 6)
+            return "ERR";
+        if (n.blocked_peer(from)) return "ERR";
+        std::lock_guard<std::mutex> g(n.mu);
+        if (lsn == n.applied_lsn + 1)
+            n.apply_locked({kind, key, a, b});
+        char buf[64];
+        snprintf(buf, sizeof buf, "A %lld", n.applied_lsn);
+        return buf;
+    }
+    if (cmd == 'R') {
+        long long key = 1;                  /* "R" alone = key 1 */
+        sscanf(line.c_str() + 1, "%lld", &key);
+        if (n.durable && !n.is_primary())
+            return forward_to_primary("R " + std::to_string(key));
+        std::lock_guard<std::mutex> g(n.mu);
+        auto it = n.regs.find(key);
+        return it != n.regs.end() ? "V " + std::to_string(it->second)
+                                  : "NIL";
+    }
+    if (cmd == 'S') {
+        std::lock_guard<std::mutex> g(n.mu);
+        std::string out = "V";
+        for (long long v : n.set_vals) out += " " + std::to_string(v);
+        return out;
+    }
+    if (cmd == 'W' || cmd == 'C' || cmd == 'A') {
+        if (!n.is_primary()) return forward_to_primary(line);
+        if (cmd == 'W') {
+            /* "W k v" keyed; "W v" = key 1 (sut_server compatible) */
+            long long k = 0, v = 0;
+            int cnt = sscanf(line.c_str() + 1, "%lld %lld", &k, &v);
+            if (cnt == 1) { v = k; k = 1; }
+            else if (cnt != 2) return "ERR";
+            return primary_commit({'W', k, v, 0});
+        }
+        if (cmd == 'A') {
+            long long v = atoll(line.c_str() + 1);
+            return primary_commit({'A', 0, v, 0});
+        }
+        /* "C k a b" keyed; "C a b" = key 1 */
+        long long k = 0, a = 0, b = 0;
+        int cnt = sscanf(line.c_str() + 1, "%lld %lld %lld", &k, &a, &b);
+        if (cnt == 2) { b = a; a = k; k = 1; }
+        else if (cnt != 3) return "ERR";
+        return primary_commit({'C', k, a, b}, /*is_cas=*/true);
+    }
+    return "ERR";
+}
+
+void serve_conn(int fd) {
+    FILE *in = fdopen(fd, "r");
+    if (in == nullptr) {
+        close(fd);
+        return;
+    }
+    char line[512];
+    while (fgets(line, sizeof line, in) != nullptr) {
+        size_t len = strlen(line);
+        while (len > 0 && (line[len - 1] == '\n' || line[len - 1] == '\r'))
+            line[--len] = 0;
+        std::string out = handle(line) + "\n";
+        if (!send_all(fd, out)) break;
+    }
+    fclose(in);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    Node &n = g_node;
+    std::string peers;
+    int c;
+    while ((c = getopt(argc, argv, "i:n:P:t:Nh")) != -1) {
+        switch (c) {
+        case 'i': n.id = atoi(optarg); break;
+        case 'n': peers = optarg; break;
+        case 'P': n.primary = atoi(optarg); break;
+        case 't': n.timeout_ms = atoi(optarg); break;
+        case 'N': n.durable = false; break;
+        default:
+            fprintf(stderr,
+                    "usage: %s -i id -n port0,port1,... [-P primary] "
+                    "[-t durable_timeout_ms] [-N (no-durable)]\n",
+                    argv[0]);
+            return 2;
+        }
+    }
+    for (const char *p = peers.c_str(); *p != 0;) {
+        n.ports.push_back(atoi(p));
+        const char *comma = strchr(p, ',');
+        if (comma == nullptr) break;
+        p = comma + 1;
+    }
+    if (n.ports.empty() || n.id < 0 ||
+        n.id >= (int)n.ports.size()) {
+        fprintf(stderr, "sut_node: bad -i/-n\n");
+        return 2;
+    }
+    n.acked_upto.assign(n.ports.size(), 0);
+    signal(SIGPIPE, SIG_IGN);
+
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)n.ports[n.id]);
+    if (bind(srv, (sockaddr *)&addr, sizeof addr) != 0 ||
+        listen(srv, 64) != 0) {
+        perror("bind/listen");
+        return 2;
+    }
+    if (n.is_primary()) {
+        for (int peer = 0; peer < (int)n.ports.size(); peer++)
+            if (peer != n.id)
+                std::thread(sender_thread, peer).detach();
+    }
+    fprintf(stderr, "sut_node %d (%s, %s) on 127.0.0.1:%d\n", n.id,
+            n.is_primary() ? "primary" : "replica",
+            n.durable ? "durable" : "no-durable", n.ports[n.id]);
+
+    for (;;) {
+        int fd = accept(srv, nullptr, nullptr);
+        if (fd < 0) continue;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        std::thread(serve_conn, fd).detach();
+    }
+}
