@@ -1,10 +1,13 @@
 /* Native nemesis — fault injection over ssh from a workload driver.
  *
  * Role of the reference's ctest/nemesis.{h,c} (breaknet/fixnet/
- * signaldb/breakclocks/fixclocks/fixall), generalized: the node list is
- * given explicitly (comma-separated) instead of scraped from cdb2
- * cluster metadata, and the target process name is a parameter instead
- * of hardcoded comdb2 pidfiles.
+ * signaldb/breakclocks/fixclocks/fixall). Nodes are "host[:port]"
+ * (comma-separated); with ports the nemesis can DISCOVER the cluster
+ * master over the SUT's info verb (the cdb2_cluster_info +
+ * sys.cmd.send('bdb cluster') role, nemesis.c:15-47), target partitions
+ * at {master, +1} (nemesis.c:90-144), and generate per-port iptables
+ * rules. The target process name is a parameter instead of hardcoded
+ * comdb2 pidfiles.
  */
 #ifndef COMDB2_TPU_NEMESIS_H
 #define COMDB2_TPU_NEMESIS_H
@@ -32,7 +35,16 @@ void nemesis_close(nemesis *n);
 /* where DRYRUN/VERBOSE output goes (default stderr) */
 void nemesis_set_trace(nemesis *n, FILE *f);
 
-/* partition a random half from the rest (iptables DROP at both sides) */
+/* pin the master index (skips discovery); -1 = unknown */
+void nemesis_set_master(nemesis *n, int idx);
+
+/* query each node's SUT info verb for the primary; returns its index
+ * or -1. Called implicitly by nem_breaknet when no master is pinned. */
+int nem_discover(nemesis *n);
+
+/* partition {master, one random other} from the rest when the master
+ * is known/discoverable (per-port DROP rules at both sides); falls
+ * back to a random half/half split otherwise */
 void nem_breaknet(nemesis *n);
 /* flush all DROP rules everywhere */
 void nem_fixnet(nemesis *n);

@@ -50,6 +50,10 @@ int sut_reg_cas(sut_handle *h, int expected, int newval);
 
 /* grow-only set (the jepsen `jepsen(id,value)` table) */
 int sut_set_add(sut_handle *h, long long val);
+/* unique add: SUT_FAIL when val is already present — the duplicate-key
+ * commit error the reference's blkseq-dup test relies on
+ * (ctest/insert.c:263-301: a replayed insert MUST return DUP) */
+int sut_set_add_unique(sut_handle *h, long long val);
 /* snapshot read; caller frees *vals with free() */
 int sut_set_read(sut_handle *h, long long **vals, size_t *n);
 

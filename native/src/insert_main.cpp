@@ -33,6 +33,11 @@ struct Opts {
     const char *edn_path = nullptr;
     uint32_t sut_flags = SUT_F_NONE;
     unsigned seed = 0;
+    /* select-stress (insert.c -s/-S/-Y/-B): verify a pre-seeded range
+     * stays exactly [0, S) in order between inserts */
+    long select_records = 0;
+    int select_bug = 0;         /* seed the range with a record missing */
+    int test_dup = 0;           /* blkseq-dup (insert.c -x) */
 };
 
 void usage(const char *argv0) {
@@ -43,8 +48,37 @@ void usage(const char *argv0) {
             "  -j file  EDN history output\n"
             "  -F       flaky SUT backend\n"
             "  -B       buggy SUT backend (MUST be caught: exit 1)\n"
+            "  -S n     select-stress: seed [0,n) and verify the range "
+            "between inserts (insert.c -s/-S)\n"
+            "  -Z       seed the select-stress range with a record "
+            "missing — the stress MUST detect it (insert.c -B)\n"
+            "  -x       blkseq-dup: re-insert each applied value and "
+            "require a duplicate failure (insert.c -x)\n"
             "  -s seed  rng seed\n",
             argv0);
+}
+
+/* select-stress check: the snapshot's sub-S values must be exactly
+ * 0..S-1 (the reference walks `select a from t1 order by a` asserting
+ * consecutive values, insert.c:181-224). Returns error count. */
+long select_stress_check(sut_handle *h, long S) {
+    long long *vals = nullptr;
+    size_t n = 0;
+    /* a transient read failure (injected flakiness) is not a
+     * consistency error — skip this round */
+    if (sut_set_read(h, &vals, &n) != SUT_OK) return 0;
+    std::vector<bool> seen((size_t)S, false);
+    long errors = 0;
+    for (size_t i = 0; i < n; i++) {
+        if (vals[i] >= 0 && vals[i] < S) {
+            if (seen[(size_t)vals[i]]) errors++;  /* dup in range */
+            seen[(size_t)vals[i]] = true;
+        }
+    }
+    free(vals);
+    for (long v = 0; v < S; v++)
+        if (!seen[(size_t)v]) errors++;           /* missing record */
+    return errors;
 }
 
 }  // namespace
@@ -52,17 +86,21 @@ void usage(const char *argv0) {
 int main(int argc, char **argv) {
     Opts opt;
     int c;
-    while ((c = getopt(argc, argv, "T:i:j:FBs:h")) != -1) {
+    while ((c = getopt(argc, argv, "T:i:j:FBS:Zxs:h")) != -1) {
         switch (c) {
         case 'T': opt.nthreads = atoi(optarg); break;
         case 'i': opt.n_inserts = atol(optarg); break;
         case 'j': opt.edn_path = optarg; break;
         case 'F': opt.sut_flags |= SUT_F_FLAKY; break;
         case 'B': opt.sut_flags |= SUT_F_BUGGY; break;
+        case 'S': opt.select_records = atol(optarg); break;
+        case 'Z': opt.select_bug = 1; break;
+        case 'x': opt.test_dup = 1; break;
         case 's': opt.seed = (unsigned)atol(optarg); break;
         default: usage(argv[0]); return 2;
         }
     }
+    const long S = opt.select_records;
 
     edn_history *edn = edn_open(opt.edn_path);
     if (opt.edn_path != nullptr && edn == nullptr) {
@@ -72,6 +110,21 @@ int main(int argc, char **argv) {
 
     std::vector<St> state((size_t)opt.n_inserts, St::FAILED);
     std::atomic<long> next{0};
+    std::atomic<long> select_errors{0};
+    std::atomic<long> blkseq_violations{0};
+
+    /* select-stress prepare: seed the range [0, S) — with -Z one
+     * record is deliberately missing and the stress MUST notice (the
+     * insert.c -Y/-B prepare, done inline since the in-memory backend
+     * is process-local) */
+    if (S > 0) {
+        sut_handle *h = sut_open(nullptr, SUT_F_NONE, opt.seed);
+        for (long v = 0; v < S; v++) {
+            if (opt.select_bug && v == S / 2) continue;
+            sut_set_add(h, v);
+        }
+        sut_close(h);
+    }
 
     auto worker = [&](int tid) {
         sut_handle *h =
@@ -81,12 +134,28 @@ int main(int argc, char **argv) {
         for (;;) {
             long v = next.fetch_add(1);
             if (v >= opt.n_inserts) break;
+            long stored = v + S;       /* keep clear of the stress range */
             edn_int(val, sizeof val, v);
             edn_emit(edn, "invoke", "add", val, process, ct_timeus());
-            int rc = sut_set_add(h, v);
+            int rc = opt.test_dup ? sut_set_add_unique(h, stored)
+                                  : sut_set_add(h, stored);
             if (rc == SUT_OK) {
                 state[(size_t)v] = St::OK;
                 edn_emit(edn, "ok", "add", val, process, ct_timeus());
+                if (opt.test_dup) {
+                    /* a replayed insert of an applied row MUST NOT
+                     * apply — the blkseq dedup contract
+                     * (insert.c:263-301). Only OK (it applied twice)
+                     * is a violation: FAIL is the expected dup error
+                     * and UNKNOWN is an injected indeterminacy, not a
+                     * double-apply. */
+                    if (sut_set_add_unique(h, stored) == SUT_OK) {
+                        CT_TRACE(stderr,
+                                 "blkseq: re-insert of %ld APPLIED "
+                                 "instead of returning DUP\n", stored);
+                        blkseq_violations.fetch_add(1);
+                    }
+                }
             } else if (rc == SUT_FAIL) {
                 state[(size_t)v] = St::FAILED;
                 edn_emit(edn, "fail", "add", val, process, ct_timeus());
@@ -95,6 +164,8 @@ int main(int argc, char **argv) {
                 edn_emit(edn, "info", "add", val, process, ct_timeus());
                 process += opt.nthreads;
             }
+            if (S > 0)
+                select_errors.fetch_add(select_stress_check(h, S));
         }
         sut_close(h);
     };
@@ -119,15 +190,19 @@ int main(int argc, char **argv) {
     std::string setbuf = "[";
     std::vector<bool> present((size_t)opt.n_inserts, false);
     long unexpected = 0;
+    bool first = true;
     for (size_t i = 0; i < n; i++) {
-        if (vals[i] < 0 || vals[i] >= opt.n_inserts) {
+        long long v = vals[i] - S;    /* stress range lives below S */
+        if (vals[i] >= 0 && vals[i] < S) continue;
+        if (v < 0 || v >= opt.n_inserts) {
             unexpected++;
             continue;
         }
-        if (present[(size_t)vals[i]]) continue;   /* dup read row */
-        present[(size_t)vals[i]] = true;
-        if (i > 0) setbuf += " ";
-        setbuf += std::to_string(vals[i]);
+        if (present[(size_t)v]) continue;         /* dup read row */
+        present[(size_t)v] = true;
+        if (!first) setbuf += " ";
+        first = false;
+        setbuf += std::to_string(v);
     }
     setbuf += "]";
     free(vals);
@@ -152,7 +227,10 @@ int main(int argc, char **argv) {
         }
     }
     printf("{\"checked\": %ld, \"lost\": %ld, \"recovered\": %ld, "
-           "\"failed\": %ld, \"unexpected\": %ld}\n",
-           checked, lost, recovered, failed, unexpected);
-    return (lost == 0 && unexpected == 0) ? 0 : 1;
+           "\"failed\": %ld, \"unexpected\": %ld, "
+           "\"select_errors\": %ld, \"blkseq_violations\": %ld}\n",
+           checked, lost, recovered, failed, unexpected,
+           select_errors.load(), blkseq_violations.load());
+    return (lost == 0 && unexpected == 0 && select_errors.load() == 0 &&
+            blkseq_violations.load() == 0) ? 0 : 1;
 }

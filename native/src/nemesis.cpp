@@ -9,11 +9,13 @@
 #include <vector>
 
 struct nemesis {
-    std::vector<std::string> nodes;
+    std::vector<std::string> hosts;
+    std::vector<int> ports;        /* 0 = unknown (no per-port rules) */
     std::string proc;
     uint32_t flags;
     std::mt19937 rng;
     FILE *trace = stderr;
+    int master = -1;               /* discovered / overridden */
 };
 
 namespace {
@@ -33,6 +35,22 @@ std::string ssh(const std::string &node, const std::string &remote_cmd) {
            " \"" + remote_cmd + "\"";
 }
 
+/* DROP rules cutting node a from node b, both directions. With known
+ * ports the rules are per-port like the reference's
+ * (nemesis.c:125-141: "-p tcp --dport <port> -j DROP"); without, they
+ * fall back to whole-host DROP. */
+void cut_pair(nemesis *n, size_t a, size_t b) {
+    auto rule = [&](size_t at, size_t from) {
+        std::string r = "iptables -A INPUT -s " + n->hosts[from];
+        if (n->ports[at] > 0)
+            r += " -p tcp --dport " + std::to_string(n->ports[at]);
+        r += " -j DROP -w";
+        run(n, ssh(n->hosts[at], r));
+    };
+    rule(a, b);
+    rule(b, a);
+}
+
 }  // namespace
 
 extern "C" {
@@ -49,10 +67,20 @@ nemesis *nemesis_open(const char *nodes_csv, const char *process_name,
     while (pos <= s.size()) {
         size_t c = s.find(',', pos);
         if (c == std::string::npos) c = s.size();
-        if (c > pos) n->nodes.push_back(s.substr(pos, c - pos));
+        if (c > pos) {
+            std::string node = s.substr(pos, c - pos);
+            size_t colon = node.rfind(':');
+            if (colon != std::string::npos) {
+                n->hosts.push_back(node.substr(0, colon));
+                n->ports.push_back(atoi(node.c_str() + colon + 1));
+            } else {
+                n->hosts.push_back(node);
+                n->ports.push_back(0);
+            }
+        }
         pos = c + 1;
     }
-    if (n->nodes.empty()) {
+    if (n->hosts.empty()) {
         delete n;
         return nullptr;
     }
@@ -67,26 +95,64 @@ void nemesis_set_trace(nemesis *n, FILE *f) {
     n->trace = f;
 }
 
-void nem_breaknet(nemesis *n) {
-    /* cut a random half from the rest, DROP rules on both sides of
-     * every cross-component pair (shape of nemesis.c:90-144, grudge
-     * math of jepsen's complete-grudge) */
-    std::vector<std::string> shuffled = n->nodes;
-    std::shuffle(shuffled.begin(), shuffled.end(), n->rng);
-    size_t half = shuffled.size() / 2;
-    for (size_t i = 0; i < shuffled.size(); i++) {
-        for (size_t j = 0; j < shuffled.size(); j++) {
-            bool cross = (i < half) != (j < half);
-            if (!cross || i == j) continue;
-            run(n, ssh(shuffled[i],
-                       "iptables -A INPUT -s " + shuffled[j] +
-                           " -j DROP -w"));
+void nemesis_set_master(nemesis *n, int idx) {
+    /* out-of-range pins fall back to "unknown" instead of becoming an
+     * out-of-bounds index in nem_breaknet */
+    n->master = (idx >= 0 && idx < (int)n->hosts.size()) ? idx : -1;
+}
+
+int nem_discover(nemesis *n) {
+    /* cluster/master discovery over the SUT's info verb — the role of
+     * the reference's cdb2_cluster_info + sys.cmd.send('bdb cluster')
+     * master scrape (nemesis.c:15-47). Nodes without a known port (or
+     * not answering) are skipped. */
+    for (size_t i = 0; i < n->hosts.size(); i++) {
+        if (n->ports[i] <= 0) continue;
+        char r[256];
+        if (ct_tcp_request(n->hosts[i].c_str(), n->ports[i], "I", 500,
+                           r, sizeof r) < 0)
+            continue;
+        int id = -1;
+        char role[32] = {0};
+        if (sscanf(r, "I %d %31s", &id, role) == 2 &&
+            strcmp(role, "primary") == 0) {
+            n->master = (int)i;
+            if (n->flags & (NEMESIS_VERBOSE | NEMESIS_DRYRUN))
+                fprintf(n->trace, "nemesis: discovered master %s:%d\n",
+                        n->hosts[i].c_str(), n->ports[i]);
+            return n->master;
         }
     }
+    return n->master;
+}
+
+void nem_breaknet(nemesis *n) {
+    /* master-targeted partition when the master is known/discoverable:
+     * cut {master, one random other} from the rest — the reference's
+     * breaknet shape (nemesis.c:90-144). Without a master, cut a
+     * random half (jepsen's partition-random-halves). Rules land on
+     * both sides of every cross-component pair. */
+    size_t count = n->hosts.size();
+    if (n->master < 0) nem_discover(n);
+    std::vector<size_t> order(count);
+    for (size_t i = 0; i < count; i++) order[i] = i;
+    size_t side_a;
+    if (n->master >= 0 && n->master < (int)count && count > 1) {
+        std::swap(order[0], order[(size_t)n->master]);
+        size_t pick = 1 + n->rng() % (count - 1);
+        std::swap(order[1], order[pick]);
+        side_a = count > 2 ? 2 : 1;
+    } else {
+        std::shuffle(order.begin(), order.end(), n->rng);
+        side_a = count / 2;
+    }
+    for (size_t i = 0; i < side_a; i++)
+        for (size_t j = side_a; j < count; j++)
+            cut_pair(n, order[i], order[j]);
 }
 
 void nem_fixnet(nemesis *n) {
-    for (const auto &node : n->nodes) {
+    for (const auto &node : n->hosts) {
         run(n, ssh(node, "iptables -F -w; iptables -X -w"));
     }
 }
@@ -99,19 +165,19 @@ void nem_signaldb(nemesis *n, int sig, int all) {
         name = buf;
     }
     if (all) {
-        for (const auto &node : n->nodes)
+        for (const auto &node : n->hosts)
             run(n, ssh(node, "killall -s " + std::string(name) + " " +
                                  n->proc));
     } else {
         const std::string &node =
-            n->nodes[n->rng() % n->nodes.size()];
+            n->hosts[n->rng() % n->hosts.size()];
         run(n, ssh(node,
                    "killall -s " + std::string(name) + " " + n->proc));
     }
 }
 
 void nem_breakclocks(nemesis *n, int max_skew_s) {
-    for (const auto &node : n->nodes) {
+    for (const auto &node : n->hosts) {
         long skew = (long)(n->rng() % (2 * (unsigned)max_skew_s + 1)) -
                     max_skew_s;
         run(n, ssh(node, "date -s @$(( $(date +%s) + " +
@@ -120,7 +186,7 @@ void nem_breakclocks(nemesis *n, int max_skew_s) {
 }
 
 void nem_fixclocks(nemesis *n) {
-    for (const auto &node : n->nodes)
+    for (const auto &node : n->hosts)
         run(n, ssh(node, "ntpdate -p 1 -b pool.ntp.org || true"));
 }
 

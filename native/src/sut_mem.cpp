@@ -8,6 +8,7 @@
  */
 #include "comdb2_tpu/sut.h"
 
+#include <algorithm>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
@@ -130,6 +131,22 @@ int sut_set_add(sut_handle *h, long long val) {
             s.set_vals.push_back(val);
         }
     }
+    if (h->flaky_unknown()) return SUT_UNKNOWN;
+    return SUT_OK;
+}
+
+int sut_set_add_unique(sut_handle *h, long long val) {
+    if (h->flaky_fail()) return SUT_FAIL;
+    Shared &s = shared();
+    int dup;
+    {
+        std::lock_guard<std::mutex> g(s.mu);
+        dup = std::find(s.set_vals.begin(), s.set_vals.end(), val) !=
+              s.set_vals.end();
+        if (!dup && !h->bug_roll())    /* buggy mode loses inserts */
+            s.set_vals.push_back(val);
+    }
+    if (dup) return SUT_FAIL;
     if (h->flaky_unknown()) return SUT_UNKNOWN;
     return SUT_OK;
 }

@@ -1,10 +1,16 @@
 #include "comdb2_tpu/testutil.h"
 
+#include <cerrno>
 #include <cstdarg>
+#include <cstring>
 #include <ctime>
 
-#include <sys/time.h>
+#include <netdb.h>
+#include <netinet/in.h>
 #include <pthread.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
 
 extern "C" {
 
@@ -37,6 +43,53 @@ void ct_tdprintf(FILE *f, const char *fn, int line, const char *fmt, ...) {
     vfprintf(f, fmt, ap);
     funlockfile(f);
     va_end(ap);
+}
+
+int ct_tcp_request(const char *host, int port, const char *line,
+                   int timeout_ms, char *reply, int reply_cap) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    char portbuf[16];
+    snprintf(portbuf, sizeof portbuf, "%d", port);
+    if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr)
+        return -1;
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    int out = -1;
+    if (fd >= 0) {
+        timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+        if (connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+            size_t len = strlen(line);
+            bool sent = true;
+            size_t off = 0;
+            while (off < len) {
+                ssize_t w = write(fd, line + off, len - off);
+                if (w < 0) {
+                    if (errno == EINTR) continue;
+                    sent = false;
+                    break;
+                }
+                off += (size_t)w;
+            }
+            if (sent && write(fd, "\n", 1) == 1) {
+                int n = 0;
+                char c;
+                while (n < reply_cap - 1) {
+                    ssize_t r = read(fd, &c, 1);
+                    if (r < 0 && errno == EINTR) continue;
+                    if (r <= 0 || c == '\n') break;
+                    reply[n++] = c;
+                }
+                reply[n] = 0;
+                out = n;
+            }
+        }
+        close(fd);
+    }
+    freeaddrinfo(res);
+    return out;
 }
 
 }  /* extern "C" */

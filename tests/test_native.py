@@ -202,3 +202,90 @@ def test_filetest_set_checker(native_build, tmp_path):
           "-T", "3", "-i", "200", "-j", str(out), "-s", "2"])
     from comdb2_tpu import filetest
     assert filetest.main([str(out), "--checker", "set"]) == 0
+
+
+def test_nemesis_master_discovery_and_targeted_partition(native_build,
+                                                         tmp_path):
+    """The native nemesis discovers the cluster's primary over the SUT
+    info verb and generates master-targeted per-port DROP rules — the
+    reference's breaknet shape (nemesis.c:15-47, 90-144)."""
+    import socket
+
+    from comdb2_tpu.workloads.tcp import spawn_cluster
+
+    socks, ports = [], []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    procs = spawn_cluster(os.path.join(native_build, "sut_node"), ports)
+    try:
+        nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+        out = tmp_path / "nem2.edn"
+        p = _run([os.path.join(native_build, "ct_register"),
+                  "-T", "2", "-i", "10", "-r", "1", "-j", str(out),
+                  "-n", nodes, "-G", "partition", "-D", "-s", "5"])
+        assert p.returncode == 0, p.stderr
+        # discovery found node 0 (the primary)
+        assert f"discovered master 127.0.0.1:{ports[0]}" in p.stderr
+        # rules are per-port and the primary participates in the cut
+        assert f"--dport {ports[0]} -j DROP" in p.stderr
+        # the cut is {master, +1} vs {remaining}: the lone cut-off
+        # replica receives DROP rules from BOTH side-a members (its
+        # port appears twice), while each side-a port appears once
+        counts = {q: p.stderr.count(f"--dport {q} -j DROP")
+                  for q in ports}
+        assert counts[ports[0]] == 1, (counts, p.stderr)
+        assert sorted(counts[q] for q in ports[1:]) == [1, 2], \
+            (counts, p.stderr)
+    finally:
+        for pr in procs:
+            pr.kill()
+        for pr in procs:
+            pr.wait()
+
+
+def test_nemesis_fallback_random_halves_without_ports(native_build,
+                                                      tmp_path):
+    """Bare hostnames (no ports): no discovery, whole-host rules, random
+    halves — the pre-discovery behavior stays available."""
+    out = tmp_path / "nem3.edn"
+    p = _run([os.path.join(native_build, "ct_register"),
+              "-T", "2", "-i", "10", "-r", "1", "-j", str(out),
+              "-n", "m1,m2,m3,m4,m5", "-G", "partition", "-D", "-s", "5"])
+    assert p.returncode == 0, p.stderr
+    assert "iptables -A INPUT -s" in p.stderr
+    assert "--dport" not in p.stderr
+
+
+def test_insert_select_stress_mode(native_build, tmp_path):
+    """insert.c -s/-S parity: the select-stress range [0,S) is verified
+    between inserts; a deliberately-broken seed (-Z, one record missing)
+    must be detected."""
+    binary = os.path.join(native_build, "ct_insert")
+    p = _run([binary, "-T", "4", "-i", "200", "-S", "50", "-s", "3"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    r = json.loads(p.stdout)
+    assert r["select_errors"] == 0 and r["checked"] == 200
+
+    p = _run([binary, "-T", "4", "-i", "200", "-S", "50", "-Z",
+              "-s", "3"])
+    assert p.returncode == 1, p.stdout
+    assert json.loads(p.stdout)["select_errors"] > 0
+
+
+def test_insert_blkseq_dup_mode(native_build, tmp_path):
+    """insert.c -x parity: re-inserting an applied row must fail as a
+    duplicate; a backend that loses the original insert (buggy mode)
+    lets the replay apply and MUST be flagged."""
+    binary = os.path.join(native_build, "ct_insert")
+    p = _run([binary, "-T", "4", "-i", "200", "-x", "-s", "3"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert json.loads(p.stdout)["blkseq_violations"] == 0
+
+    p = _run([binary, "-T", "4", "-i", "200", "-x", "-B", "-s", "3"])
+    assert p.returncode == 1, p.stdout
+    assert json.loads(p.stdout)["blkseq_violations"] > 0
