@@ -64,10 +64,13 @@ def analysis(model: Model,
     capacities: device frontier sizes tried in order; overflow escalates,
     overflow at the last yields :unknown.
     progress: optional callback ``progress(done_segments, total_segments,
-    frontier_count)`` invoked between device chunks at roughly
+    frontier_count, stats)`` invoked between device chunks at roughly
     ``progress_interval_s`` cadence — the role of the reference's
-    5-second reporter threads (``linear.clj:273-297``). When given, the
-    device path runs chunked.
+    5-second reporter threads (``linear.clj:273-297``). ``stats`` is a
+    dict with ``visited_per_s`` (configs stepped per second),
+    ``segs_per_s``, and ``est_cost`` (the Σ n·n! pending-count cost
+    model of ``linear/config.clj:374-393``). When given, the device
+    path runs chunked.
     """
     t0 = time.monotonic()
     packed = (history if isinstance(history, PackedHistory)
@@ -187,8 +190,10 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
             S = segs.ok_proc.shape[0]
             chunk = max(_next_pow2(min(S, 2048)), 64)
             carry = LJ.init_seg_carry(F, P2)
-            last = time.monotonic()
+            t_run = time.monotonic()
+            last = t_run
             done = 0
+            visited = 0
             while done < S:
                 end = min(done + chunk, S)
                 pad = chunk - (end - done)
@@ -201,12 +206,24 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
                 carry = LJ.check_device_seg2_chunk(
                     succ, ip, it, op_, dp, done, carry, F=F, Fs=Fs,
                     P=P2, **sizes)
+                visited += int(carry[3]) * (end - done)
                 done = end
                 if int(carry[4]) != LJ.VALID:
                     break
                 now = time.monotonic()
                 if now - last >= progress_interval_s:
-                    progress(min(done, s_real), s_real, int(carry[3]))
+                    # pending counts from the carry: telemetry parity
+                    # with the reference's visited/s + estimated-cost
+                    # reporters (core.clj:442-460, config.clj:374-393).
+                    # Bucketed on device so only P+1 ints ride the
+                    # (slow) tunnel per tick, never the (F, P) frontier
+                    hist = np.asarray(LJ.pending_histogram(
+                        carry[1], carry[2], P=P2))
+                    el = max(now - t_run, 1e-9)
+                    progress(min(done, s_real), s_real, int(carry[3]),
+                             {"visited_per_s": visited / el,
+                              "segs_per_s": done / el,
+                              "est_cost": LJ.estimated_cost_hist(hist)})
                     last = now
             status, fail_seg, n_final = carry[4], carry[5], carry[3]
         status = int(status)

@@ -89,6 +89,35 @@ def make_stream(packed, n_pad: Optional[int] = None) -> StepStream:
     return StepStream(kind, proc, tr)
 
 
+def estimated_cost(pending_counts) -> float:
+    """Σ n·n! over configs — the reference's search-cost estimate by
+    pending-call count (``knossos/linear/config.clj:374-393``): each
+    config with n pending calls can spawn up to n·Γ(n+1) orders."""
+    import math
+
+    return float(sum(n * math.factorial(min(int(n), 12))
+                     for n in pending_counts))
+
+
+def estimated_cost_hist(hist) -> float:
+    """:func:`estimated_cost` from a pending-count histogram
+    (``hist[k]`` = configs with k pending calls)."""
+    import math
+
+    return float(sum(int(c) * k * math.factorial(min(k, 12))
+                     for k, c in enumerate(hist)))
+
+
+@functools.partial(jax.jit, static_argnames=("P",))
+def pending_histogram(slots, valid, *, P: int):
+    """Per-config pending-call counts bucketed on device: the progress
+    telemetry needs only P+1 ints back over the (slow) tunnel, not the
+    whole (F, P) frontier."""
+    pend = jnp.sum(slots >= 0, axis=1)
+    return jnp.bincount(pend, weights=valid.astype(jnp.int32),
+                        length=P + 1)
+
+
 def pad_succ(succ: np.ndarray, s_pad: Optional[int] = None,
              t_pad: Optional[int] = None) -> np.ndarray:
     """Pad the successor table to bucketed shapes (recompile avoidance).

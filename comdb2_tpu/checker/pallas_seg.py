@@ -873,21 +873,33 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     call = _chunk_jit(spec)
     res = jnp.zeros((8, LANES), jnp.int32)       # unused: no RESETs
     s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
-    last = time.monotonic()
+    t_run = time.monotonic()
+    last = t_run
     prev_hi, prev_lo, done = hi, lo, 0
+    visited = 0
     for c in range(seg_chunks.shape[0]):
         off = np.array([c * spec.chunk, n_transitions], np.int32)
         hi, lo, stat, res = call(jnp.asarray(seg_chunks[c]),
                                  jnp.asarray(off), hi, lo,
                                  stat, res, table)
         st = np.asarray(stat)
+        visited += int(st[0, 2]) * spec.chunk
         if int(st[0, 0]) != VALID:
             break
         prev_hi, prev_lo, done = hi, lo, (c + 1) * spec.chunk
         now = time.monotonic()
         if progress is not None and now - last >= progress_interval_s:
+            from .linear_jax import estimated_cost
+
+            cfgs = decode_frontier(spec, np.asarray(hi),
+                                   np.asarray(lo), spec.P)
+            pend = [sum(1 for t in sl if t >= 0) for _, sl in cfgs]
+            el = max(now - t_run, 1e-9)
             progress(min((c + 1) * spec.chunk, s_real), s_real,
-                     int(st[0, 2]))
+                     int(st[0, 2]),
+                     {"visited_per_s": visited / el,
+                      "segs_per_s": done / el,
+                      "est_cost": estimated_cost(pend)})
             last = now
     st = np.asarray(stat)
     out = (int(st[0, 0]), int(st[0, 1]), int(st[0, 2]))

@@ -1,0 +1,29 @@
+/* TCP HA backend of the SUT client ABI (sut_tcp.cpp) — node-list
+ * routing, retry-elsewhere, snapshot-LSN read tracking over a
+ * replicated sut_node cluster (the cdb2api HA role,
+ * cdb2api.c:618-656). Normally reached through sut_open("h:p,...");
+ * this header exists so sut_mem.cpp's dispatch and the backend stay
+ * in one signature. */
+#ifndef COMDB2_TPU_SUT_TCP_H
+#define COMDB2_TPU_SUT_TCP_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct sut_tcp sut_tcp;
+
+sut_tcp *sut_tcp_open(const char *target, unsigned seed);
+void sut_tcp_close(sut_tcp *t);
+int sut_tcp_reg_read(sut_tcp *t, int *val, int *found);
+int sut_tcp_reg_write(sut_tcp *t, int val);
+int sut_tcp_reg_cas(sut_tcp *t, int expected, int newval);
+int sut_tcp_set_add(sut_tcp *t, long long val);
+int sut_tcp_set_read(sut_tcp *t, long long **vals, size_t *n);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

@@ -23,9 +23,12 @@ void ct_tdprintf(FILE *f, const char *fn, int line, const char *fmt, ...);
 #define CT_TRACE(f, ...) ct_tdprintf((f), __func__, __LINE__, __VA_ARGS__)
 
 /* one line-protocol request/reply over TCP (connect, send line+\n,
- * read reply up to \n). Returns reply length >= 0, or -1 on any
- * failure (resolve/connect/timeout/short write). Shared by the nemesis
- * discovery and any driver that talks to a line-protocol SUT. */
+ * read reply up to \n). Returns reply length >= 0; -1 when the
+ * connection was never established (safe to retry elsewhere); -2 when
+ * the failure happened after connecting (the request MAY have been
+ * delivered — mutating callers must treat the op as indeterminate).
+ * Shared by the nemesis discovery and any driver that talks to a
+ * line-protocol SUT. */
 int ct_tcp_request(const char *host, int port, const char *line,
                    int timeout_ms, char *reply, int reply_cap);
 

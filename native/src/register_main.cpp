@@ -29,6 +29,7 @@ struct Opts {
     int nthreads = 5;
     double runtime_s = 10.0;
     long max_ops = -1;           /* per thread; -1 = time-bound only */
+    const char *target = nullptr; /* "host:port,..." = TCP HA client */
     const char *edn_path = nullptr;
     const char *nodes = nullptr; /* enable nemesis when set */
     const char *proc = "comdb2";
@@ -46,6 +47,10 @@ void usage(const char *argv0) {
             "  -r secs     runtime (default 10)\n"
             "  -i n        max ops per thread\n"
             "  -j file     EDN history output\n"
+            "  -d target   SUT target: host:port[,host:port...] — the\n"
+            "              HA TCP client over a replicated cluster\n"
+            "              (cdb2api node-list routing; default: the\n"
+            "              in-memory backend)\n"
             "  -n csv      node list; enables nemesis events\n"
             "  -P name     SUT process name for sigstop events\n"
             "  -G ev       add nemesis event: partition|sigstop|clock\n"
@@ -60,11 +65,18 @@ struct Driver {
     Opts opt;
     edn_history *edn;
     std::atomic<long> total_ops{0};
+    std::atomic<int> workers_ok{0};
 
     void thread_main(int tid) {
         std::mt19937 rng(opt.seed * 7919u + (unsigned)tid + 1);
-        sut_handle *h = sut_open(nullptr, opt.sut_flags,
+        sut_handle *h = sut_open(opt.target, opt.sut_flags,
                                  opt.seed * 31u + (unsigned)tid);
+        if (h == nullptr) {
+            CT_TRACE(stderr, "bad SUT target %s\n",
+                     opt.target != nullptr ? opt.target : "(null)");
+            return;
+        }
+        workers_ok.fetch_add(1);
         uint64_t deadline =
             ct_timems() + (uint64_t)(opt.runtime_s * 1000);
         int process = tid;
@@ -135,7 +147,7 @@ struct Driver {
 int main(int argc, char **argv) {
     Opts opt;
     int c;
-    while ((c = getopt(argc, argv, "T:r:i:j:n:P:G:FBs:Dh")) != -1) {
+    while ((c = getopt(argc, argv, "T:r:i:j:d:n:P:G:FBs:Dh")) != -1) {
         switch (c) {
         case 'T': opt.nthreads = atoi(optarg); break;
         case 'r': opt.runtime_s = atof(optarg); break;
@@ -143,6 +155,7 @@ int main(int argc, char **argv) {
         case 'j': opt.edn_path = optarg; break;
         case 'n': opt.nodes = optarg; break;
         case 'P': opt.proc = optarg; break;
+        case 'd': opt.target = optarg; break;
         case 'G':
             if (strcmp(optarg, "partition") == 0) opt.events |= 1;
             else if (strcmp(optarg, "sigstop") == 0) opt.events |= 2;
@@ -201,5 +214,10 @@ int main(int argc, char **argv) {
     }
     fprintf(stderr, "register driver: %ld ops across %d threads\n",
             d.total_ops.load(), opt.nthreads);
+    if (d.workers_ok.load() == 0) {
+        fprintf(stderr, "no worker could open the SUT — empty history "
+                        "would pass vacuously\n");
+        return 2;
+    }
     return 0;
 }

@@ -7,6 +7,7 @@
  * driver ↔ EDN ↔ checker pipeline without a cluster.
  */
 #include "comdb2_tpu/sut.h"
+#include "comdb2_tpu/sut_tcp.h"
 
 #include <algorithm>
 #include <cstdlib>
@@ -42,6 +43,7 @@ struct sut_handle {
     uint32_t flags;
     std::mt19937 rng;
     unsigned bug_n = 0;
+    sut_tcp *tcp = nullptr;     /* non-null: ops route over TCP */
 
     explicit sut_handle(uint32_t fl, unsigned seed) : flags(fl), rng(seed) {}
 
@@ -63,15 +65,25 @@ struct sut_handle {
 
 extern "C" {
 
-sut_handle *sut_open(const char *, uint32_t flags, unsigned seed) {
-    return new sut_handle(flags, seed);
+sut_handle *sut_open(const char *target, uint32_t flags, unsigned seed) {
+    auto *h = new sut_handle(flags, seed);
+    if (target != nullptr && strchr(target, ':') != nullptr) {
+        h->tcp = sut_tcp_open(target, seed);
+        if (h->tcp == nullptr) {
+            delete h;
+            return nullptr;
+        }
+    }
+    return h;
 }
 
 void sut_close(sut_handle *h) {
+    if (h->tcp != nullptr) sut_tcp_close(h->tcp);
     delete h;
 }
 
 int sut_reg_read(sut_handle *h, int *val, int *found) {
+    if (h->tcp != nullptr) return sut_tcp_reg_read(h->tcp, val, found);
     if (h->flaky_fail()) return SUT_FAIL;
     Shared &s = shared();
     std::lock_guard<std::mutex> g(s.mu);
@@ -86,6 +98,7 @@ int sut_reg_read(sut_handle *h, int *val, int *found) {
 }
 
 int sut_reg_write(sut_handle *h, int val) {
+    if (h->tcp != nullptr) return sut_tcp_reg_write(h->tcp, val);
     if (h->flaky_fail()) return SUT_FAIL;
     Shared &s = shared();
     {
@@ -102,6 +115,8 @@ int sut_reg_write(sut_handle *h, int val) {
 }
 
 int sut_reg_cas(sut_handle *h, int expected, int newval) {
+    if (h->tcp != nullptr)
+        return sut_tcp_reg_cas(h->tcp, expected, newval);
     if (h->flaky_fail()) return SUT_FAIL;
     Shared &s = shared();
     int applied;
@@ -123,6 +138,7 @@ int sut_reg_cas(sut_handle *h, int expected, int newval) {
 }
 
 int sut_set_add(sut_handle *h, long long val) {
+    if (h->tcp != nullptr) return sut_tcp_set_add(h->tcp, val);
     if (h->flaky_fail()) return SUT_FAIL;
     Shared &s = shared();
     {
@@ -136,6 +152,7 @@ int sut_set_add(sut_handle *h, long long val) {
 }
 
 int sut_set_add_unique(sut_handle *h, long long val) {
+    if (h->tcp != nullptr) return SUT_FAIL;   /* no wire verb (yet) */
     if (h->flaky_fail()) return SUT_FAIL;
     Shared &s = shared();
     int dup;
@@ -152,6 +169,7 @@ int sut_set_add_unique(sut_handle *h, long long val) {
 }
 
 int sut_set_read(sut_handle *h, long long **vals, size_t *n) {
+    if (h->tcp != nullptr) return sut_tcp_set_read(h->tcp, vals, n);
     if (h->flaky_fail()) return SUT_FAIL;
     Shared &s = shared();
     std::lock_guard<std::mutex> g(s.mu);

@@ -1,0 +1,197 @@
+/* TCP backend for the SUT client ABI — HA client semantics over the
+ * replicated sut_node cluster.
+ *
+ * The role of cdb2api's HA machinery (cdb2api.c:618-656): the handle
+ * holds a NODE LIST, opens against a random node (CDB2_RANDOM), and on
+ * connection failure RETRIES ELSEWHERE; reads track the highest
+ * applied LSN this handle has observed (the snapshot_file/snapshot_lsn
+ * role) and are only served by nodes at or past it, so a failover
+ * never sends a session backwards in time. A mutating op whose request
+ * was sent but never answered is indeterminate (SUT_UNKNOWN) — without
+ * the reference's cnonce/blkseq dedup a blind retry could double-apply,
+ * so the honest outcome is :info, exactly the harness's rule.
+ *
+ * Selected by sut_open(target) when target looks like
+ * "host:port[,host:port...]"; sut_mem keeps serving target == NULL.
+ */
+#include "comdb2_tpu/sut.h"
+#include "comdb2_tpu/sut_tcp.h"
+#include "comdb2_tpu/testutil.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+struct sut_tcp {
+    std::vector<std::string> hosts;
+    std::vector<int> ports;
+    std::mt19937 rng;
+    int timeout_ms = 1000;
+    int max_retries = 5;            /* nodes tried per op */
+    long long seen_lsn = 0;         /* snapshot tracking */
+    size_t cur = 0;                 /* current node (sticky) */
+};
+
+namespace {
+
+/* one request against the CURRENT node; rc: 0 ok, -1 never connected
+ * (safe to retry elsewhere), -2 connected-but-failed (the request MAY
+ * have been delivered — mutating ops must NOT retry) */
+int node_request(sut_tcp *t, const std::string &line, char *reply,
+                 int cap) {
+    int n = ct_tcp_request(t->hosts[t->cur].c_str(), t->ports[t->cur],
+                           line.c_str(), t->timeout_ms, reply, cap);
+    if (n >= 0) return 0;
+    return n;      /* ct_tcp_request's -1/-2 carry the same meaning */
+}
+
+void next_node(sut_tcp *t) {
+    t->cur = (t->cur + 1) % t->hosts.size();
+}
+
+/* applied LSN of the current node via the info verb; -1 unreachable */
+long long node_applied(sut_tcp *t) {
+    char reply[128];
+    if (ct_tcp_request(t->hosts[t->cur].c_str(), t->ports[t->cur], "I",
+                       t->timeout_ms, reply, sizeof reply) < 0)
+        return -1;
+    int id;
+    char role[32];
+    long long applied = -1, durable = -1;
+    if (sscanf(reply, "I %d %31s %lld %lld", &id, role, &applied,
+               &durable) >= 3)
+        return applied;
+    return -1;
+}
+
+/* mutating op: sticky node, retry-elsewhere ONLY on clean connect
+ * failure, indeterminate once the request may have been delivered */
+int mutate(sut_tcp *t, const std::string &line) {
+    char reply[128];
+    for (int attempt = 0; attempt < t->max_retries; attempt++) {
+        int rc = node_request(t, line, reply, sizeof reply);
+        if (rc == 0) {
+            if (strcmp(reply, "OK") == 0) return SUT_OK;
+            if (strcmp(reply, "FAIL") == 0) return SUT_FAIL;
+            return SUT_UNKNOWN;
+        }
+        if (rc == -2) return SUT_UNKNOWN;
+        next_node(t);               /* clean failure: retry elsewhere */
+    }
+    return SUT_FAIL;                /* never delivered anywhere */
+}
+
+/* read: retry elsewhere freely, but only accept an answer from a node
+ * at or past this session's snapshot LSN */
+int read_op(sut_tcp *t, const std::string &line, char *reply, int cap) {
+    for (int attempt = 0; attempt < t->max_retries; attempt++) {
+        long long applied = node_applied(t);
+        if (applied < 0 || applied < t->seen_lsn) {
+            next_node(t);           /* lagging/unreachable replica */
+            continue;
+        }
+        int rc = node_request(t, line, reply, cap);
+        if (rc == 0) {
+            if (applied > t->seen_lsn) t->seen_lsn = applied;
+            return 0;
+        }
+        next_node(t);
+    }
+    return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+sut_tcp *sut_tcp_open(const char *target, unsigned seed) {
+    auto *t = new sut_tcp();
+    t->rng.seed(seed);
+    std::string s(target);
+    size_t pos = 0;
+    while (pos <= s.size()) {
+        size_t c = s.find(',', pos);
+        if (c == std::string::npos) c = s.size();
+        if (c > pos) {
+            std::string node = s.substr(pos, c - pos);
+            size_t colon = node.rfind(':');
+            if (colon == std::string::npos) {
+                delete t;
+                return nullptr;
+            }
+            t->hosts.push_back(node.substr(0, colon));
+            t->ports.push_back(atoi(node.c_str() + colon + 1));
+        }
+        pos = c + 1;
+    }
+    if (t->hosts.empty()) {
+        delete t;
+        return nullptr;
+    }
+    t->cur = t->rng() % t->hosts.size();   /* CDB2_RANDOM routing */
+    return t;
+}
+
+void sut_tcp_close(sut_tcp *t) {
+    delete t;
+}
+
+int sut_tcp_reg_read(sut_tcp *t, int *val, int *found) {
+    char reply[128];
+    if (read_op(t, "R 1", reply, sizeof reply) != 0) return SUT_FAIL;
+    if (strcmp(reply, "NIL") == 0) {
+        *found = 0;
+        *val = 0;
+        return SUT_OK;
+    }
+    if (reply[0] == 'V') {
+        *val = atoi(reply + 1);
+        *found = 1;
+        return SUT_OK;
+    }
+    return SUT_UNKNOWN;
+}
+
+int sut_tcp_reg_write(sut_tcp *t, int val) {
+    return mutate(t, "W 1 " + std::to_string(val));
+}
+
+int sut_tcp_reg_cas(sut_tcp *t, int expected, int newval) {
+    return mutate(t, "C 1 " + std::to_string(expected) + " " +
+                         std::to_string(newval));
+}
+
+int sut_tcp_set_add(sut_tcp *t, long long val) {
+    return mutate(t, "A " + std::to_string(val));
+}
+
+int sut_tcp_set_read(sut_tcp *t, long long **vals, size_t *n) {
+    /* heap buffer sized for millions of values; a reply that fills it
+     * completely may be truncated mid-number — fail rather than return
+     * a silently-corrupted snapshot */
+    const int cap = 32 << 20;
+    std::vector<char> buf((size_t)cap);
+    char *reply = buf.data();
+    if (read_op(t, "S", reply, cap) != 0) return SUT_FAIL;
+    if (reply[0] != 'V') return SUT_FAIL;
+    if ((int)strlen(reply) >= cap - 1) return SUT_FAIL;
+    std::vector<long long> out;
+    const char *p = reply + 1;
+    char *end = nullptr;
+    for (;;) {
+        long long v = strtoll(p, &end, 10);
+        if (end == p) break;
+        out.push_back(v);
+        p = end;
+    }
+    *n = out.size();
+    *vals = static_cast<long long *>(
+        malloc(sizeof(long long) * (out.size() + 1)));
+    memcpy(*vals, out.data(), sizeof(long long) * out.size());
+    return SUT_OK;
+}
+
+}  /* extern "C" */

@@ -61,6 +61,8 @@ int ct_tcp_request(const char *host, int port, const char *line,
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
         setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
         if (connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+            out = -2;   /* connected: a failure past here means the
+                         * request MAY have been delivered */
             size_t len = strlen(line);
             bool sent = true;
             size_t off = 0;

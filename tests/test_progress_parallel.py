@@ -17,9 +17,10 @@ def test_chunked_device_matches_plain():
             h = mutate(rng, h)
         plain = analysis(M.cas_register(), h, backend="device")
         calls = []
-        chunked = analysis(M.cas_register(), h, backend="device",
-                           progress=lambda d, s, n: calls.append((d, s, n)),
-                           progress_interval_s=0.0)
+        chunked = analysis(
+            M.cas_register(), h, backend="device",
+            progress=lambda d, s, n, st: calls.append((d, s, n, st)),
+            progress_interval_s=0.0)
         assert chunked.valid == plain.valid
         if chunked.valid is False:
             assert chunked.op_index == plain.op_index
@@ -27,8 +28,13 @@ def test_chunked_device_matches_plain():
         # boundary at least when valid
         if chunked.valid is True:
             assert calls
-            d, s, n = calls[-1]
+            d, s, n, st = calls[-1]
             assert d <= s and n >= 1
+            # telemetry parity: visited/s + estimated cost ride along
+            # (knossos core.clj:442-460, linear/config.clj:374-393)
+            assert st["visited_per_s"] > 0
+            assert st["segs_per_s"] > 0
+            assert st["est_cost"] >= 0
 
 
 def test_progress_not_called_without_interval():
