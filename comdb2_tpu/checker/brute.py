@@ -31,7 +31,7 @@ def brute_valid(model: Model, history: List[Op]) -> bool:
     """True iff some linearization of the history's completed calls (with
     info calls optionally interleaved anywhere after their invocation) is
     legal under ``model``. History need not be completed/indexed."""
-    h = hist.index(hist.complete(history))
+    h = hist.complete(history, index=True)
     calls: List[_Call] = []
     inflight = {}
     for op in h:

@@ -14,7 +14,7 @@ objects.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Any, Hashable, Optional
 
 # Op types. Integer codes are the on-tensor encoding.
@@ -47,7 +47,15 @@ class Op:
     extra: dict = field(default_factory=dict)
 
     def with_(self, **kw) -> "Op":
-        return replace(self, **kw)
+        # hand-rolled replace(): the dataclasses version re-runs
+        # __init__ with type checks and dominates host-side history
+        # packing (millions of calls on the 4096-history batch axis)
+        bad = kw.keys() - self.__dict__.keys()
+        if bad:     # replace() raised on unknown fields; keep that
+            raise TypeError(f"unknown Op field(s): {sorted(bad)}")
+        new = Op.__new__(Op)
+        new.__dict__ = {**self.__dict__, **kw}
+        return new
 
     @property
     def type_code(self) -> int:

@@ -85,7 +85,7 @@ def pack_history(history: List[Op], completed: bool = False) -> PackedHistory:
     :func:`comdb2_tpu.ops.history.complete` and :func:`...history.index`.
     """
     if not completed:
-        history = hist.index(hist.complete(history))
+        history = hist.complete(history, index=True)
     n = len(history)
     process = np.empty(n, np.int32)
     type_ = np.empty(n, np.int8)

@@ -75,7 +75,7 @@ def html(test: dict, history: Sequence[Op],
          path: Optional[str] = None) -> str:
     """Render the timeline; optionally write it to ``path``
     (``timeline.clj:92-111``)."""
-    h = index(complete(list(history)))
+    h = complete(list(history), index=True)
     pindex = process_index(h)
     divs = "\n".join(_pair_div(len(h), pindex, a, b) for a, b in pairs(h))
     doc = (f"<html><head><style>{STYLESHEET}</style></head><body>"
