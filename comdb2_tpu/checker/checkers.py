@@ -111,7 +111,31 @@ class Linearizable(Checker):
         out = a.to_map()
         if "configs" in out:
             out["configs"] = out["configs"][:10]
+        if out.get("paths"):
+            out["paths"] = out["paths"][:10]
+        if a.valid is False:
+            self._render_svg(test, history, a, opts)
         return out
+
+    @staticmethod
+    def _render_svg(test, history, a, opts) -> None:
+        """Drop ``linear.svg`` (failing window + final paths) into the
+        test's store dir on failure, like the reference's linearizable
+        checker (``checker.clj:71-85`` → ``render-analysis!``).
+        Best-effort: rendering must never destroy a verdict."""
+        import os
+
+        from ..harness.store import artifact_dir
+
+        base = artifact_dir(test, opts)
+        if base is None:
+            return
+        try:
+            from ..report import linear_svg
+            linear_svg.render_analysis(list(history), a,
+                                       os.path.join(base, "linear.svg"))
+        except Exception:
+            pass
 
 
 linearizable = Linearizable()

@@ -72,8 +72,23 @@ class IndependentChecker(Checker):
         self.mesh = mesh
 
     def check(self, test, model, history, opts=None):
+        import os
+
+        from ..harness.store import artifact_dir
+
         ks = history_keys(history)
         subs = {k: subhistory(k, history) for k in ks}
+        # per-key artifact routing: a failing base checker writes its
+        # counterexample under independent/<k>/ (the reference's per-key
+        # store layout) instead of every key clobbering one linear.svg
+        base_dir = artifact_dir(test, opts)
+
+        def key_opts(k):
+            if base_dir is None:
+                return opts
+            return {**(opts or {}),
+                    "dir": os.path.join(base_dir, "independent", str(k))}
+
         # honor an explicit host backend: fault-heavy harness histories
         # have retirement-inflated process counts whose one-off device
         # shapes cost minutes of compile for milliseconds of work
@@ -81,9 +96,11 @@ class IndependentChecker(Checker):
                          and getattr(self.base, "backend", None) == "host")
         if isinstance(self.base, Linearizable) and len(ks) > 1 \
                 and device_ok:
-            results = self._check_linearizable_batch(model, subs)
+            results = self._check_linearizable_batch(model, subs,
+                                                     key_opts)
         else:
-            results = {k: check_safe(self.base, test, model, subs[k], opts)
+            results = {k: check_safe(self.base, test, model, subs[k],
+                                     key_opts(k))
                        for k in ks}
         self._write_artifacts(test, subs, results, opts)
         # false > unknown > true, like compose; only definitively-invalid
@@ -100,11 +117,9 @@ class IndependentChecker(Checker):
         (``independent.clj:272-283``); best-effort."""
         import os
 
-        base = (opts or {}).get("dir") or (test or {}).get("dir")
-        if base is None and (test or {}).get("name") \
-                and test.get("start-time"):
-            from ..harness import store
-            base = store.path(test)
+        from ..harness.store import artifact_dir
+
+        base = artifact_dir(test, opts)
         if base is None:
             return
         from ..harness.store import _edn_safe
@@ -124,7 +139,8 @@ class IndependentChecker(Checker):
             # turn an already-computed verdict into :unknown
             pass
 
-    def _check_linearizable_batch(self, model, subs: Dict[Any, List[Op]]
+    def _check_linearizable_batch(self, model, subs: Dict[Any, List[Op]],
+                                  key_opts=lambda k: None
                                   ) -> Dict[Any, dict]:
         """One device launch for all keys; unknowns (frontier overflow)
         and packing failures fall back to the per-key escalating path."""
@@ -139,7 +155,8 @@ class IndependentChecker(Checker):
             status, fail_at, _ = B.check_batch(pb, F=self.batch_frontier,
                                                mesh=self.mesh)
         except Exception:
-            return {k: check_safe(self.base, {}, model, subs[k], None)
+            return {k: check_safe(self.base, {}, model, subs[k],
+                                  key_opts(k))
                     for k in ks}
         results: Dict[Any, dict] = {}
         for i, k in enumerate(ks):
@@ -149,7 +166,8 @@ class IndependentChecker(Checker):
             else:
                 # invalid or overflow: re-check solo for an exact verdict
                 # with escalation and a decoded counterexample
-                results[k] = check_safe(self.base, {}, model, subs[k], None)
+                results[k] = check_safe(self.base, {}, model, subs[k],
+                                        key_opts(k))
         return results
 
 

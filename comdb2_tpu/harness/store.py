@@ -37,6 +37,18 @@ def path(test: dict, *more: str) -> str:
                         str(test.get("start-time", "notime")), *more)
 
 
+def artifact_dir(test, opts=None):
+    """Where a checker may drop artifacts: opts dir > test dir > the
+    test's store path (when the test is named and timed); None when no
+    location is known. Shared by the SVG-on-failure renderer and the
+    independent checker's per-key artifact writer."""
+    base = (opts or {}).get("dir") or (test or {}).get("dir")
+    if base is None and (test or {}).get("name") \
+            and test.get("start-time"):
+        base = path(test)
+    return base
+
+
 def path_mkdirs(test: dict, *more: str) -> str:
     p = path(test, *more)
     os.makedirs(os.path.dirname(p), exist_ok=True)
