@@ -59,9 +59,13 @@ class SutConnection:
         except OSError as e:
             self.close()
             raise TimeoutError(f"SUT connection lost on {line!r}") from e
-        if not reply:
+        if not reply.endswith("\n"):
+            # empty = connection closed; partial = the server died or
+            # stalled MID-REPLY — accepting "V 12" for "V 123" would
+            # fabricate a wrong read under exactly the faults the
+            # harness injects (same contract as ct_tcp_request's -2)
             self.close()
-            raise TimeoutError(f"SUT closed connection on {line!r}")
+            raise TimeoutError(f"SUT truncated reply on {line!r}")
         return reply.strip()
 
 

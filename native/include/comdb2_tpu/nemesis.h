@@ -8,6 +8,14 @@
  * at {master, +1} (nemesis.c:90-144), and generate per-port iptables
  * rules. The target process name is a parameter instead of hardcoded
  * comdb2 pidfiles.
+ *
+ * Topology assumption: ONE NODE PER HOST. The per-port iptables rules
+ * match on source host + destination port only ("-s <host> --dport
+ * <port>"), so on a co-hosted cluster (several nodes sharing one host,
+ * e.g. the localhost sut_node cluster) a rule drops ALL of that host's
+ * traffic to the port — clients included — and cannot single out one
+ * peer. Co-hosted deployments should partition through the SUT's own
+ * B/U control verbs instead (what the Python ClusterControl does).
  */
 #ifndef COMDB2_TPU_NEMESIS_H
 #define COMDB2_TPU_NEMESIS_H

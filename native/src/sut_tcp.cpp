@@ -169,15 +169,15 @@ int sut_tcp_set_add(sut_tcp *t, long long val) {
 }
 
 int sut_tcp_set_read(sut_tcp *t, long long **vals, size_t *n) {
-    /* heap buffer sized for millions of values; a reply that fills it
-     * completely may be truncated mid-number — fail rather than return
-     * a silently-corrupted snapshot */
+    /* heap buffer sized for millions of values; truncation (a line
+     * that fills the buffer without its newline) is handled one layer
+     * down — ct_tcp_request returns -2 for any reply missing its
+     * terminating newline, so an rc==0 reply here is complete */
     const int cap = 32 << 20;
     std::vector<char> buf((size_t)cap);
     char *reply = buf.data();
     if (read_op(t, "S", reply, cap) != 0) return SUT_FAIL;
     if (reply[0] != 'V') return SUT_FAIL;
-    if ((int)strlen(reply) >= cap - 1) return SUT_FAIL;
     std::vector<long long> out;
     const char *p = reply + 1;
     char *end = nullptr;

@@ -77,15 +77,26 @@ int ct_tcp_request(const char *host, int port, const char *line,
             }
             if (sent && write(fd, "\n", 1) == 1) {
                 int n = 0;
+                bool got_nl = false;
                 char c;
                 while (n < reply_cap - 1) {
                     ssize_t r = read(fd, &c, 1);
                     if (r < 0 && errno == EINTR) continue;
-                    if (r <= 0 || c == '\n') break;
+                    if (r <= 0) break;
+                    if (c == '\n') {
+                        got_nl = true;
+                        break;
+                    }
                     reply[n++] = c;
                 }
                 reply[n] = 0;
-                out = n;
+                /* a reply is complete only at its newline: a recv
+                 * timeout, mid-line EOF, or a cap-filling line would
+                 * otherwise hand back a truncated "V 12" for "V 123"
+                 * as success — a fabricated wrong read under exactly
+                 * the faults the harness injects. Incomplete stays -2
+                 * (indeterminate: the request WAS delivered). */
+                out = got_nl ? n : -2;
             }
         }
         close(fd);

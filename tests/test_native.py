@@ -353,3 +353,80 @@ def test_register_driver_ha_tcp_cluster(native_build, tmp_path):
             pr.kill()
         for pr in procs:
             pr.wait()
+
+
+def _serve_once(payload, linger=0.0):
+    """One-shot fake server: accept, read the request, write ``payload``
+    (possibly partial / stalled), close. Returns the port."""
+    import socket
+    import threading
+    import time
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def run():
+        c, _ = srv.accept()
+        c.recv(4096)
+        if payload:
+            c.sendall(payload)
+        if linger:
+            time.sleep(linger)
+        c.close()
+        srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def test_tcp_request_truncated_reply_is_indeterminate(native_build):
+    """ct_tcp_request completes a reply only at its newline: a mid-line
+    EOF, a recv timeout, or a cap-filling line must come back -2
+    (indeterminate), never a truncated "V 12" for "V 123" success —
+    that would fabricate a wrong read under exactly the faults the
+    harness injects (round-2 ADVICE medium)."""
+    import ctypes
+    import socket
+
+    lib = ctypes.CDLL(os.path.join(native_build, "libct_sut.so"))
+    lib.ct_tcp_request.restype = ctypes.c_int
+    lib.ct_tcp_request.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int]
+
+    def req(port, timeout_ms=500, cap=128):
+        buf = ctypes.create_string_buffer(cap)
+        rc = lib.ct_tcp_request(b"127.0.0.1", port, b"R", timeout_ms,
+                                buf, cap)
+        return rc, buf.value
+
+    rc, val = req(_serve_once(b"V 123\n"))
+    assert (rc, val) == (5, b"V 123")          # complete reply
+    rc, _ = req(_serve_once(b"V 12"))
+    assert rc == -2                            # mid-line EOF
+    rc, _ = req(_serve_once(b"V 1", linger=1.5), timeout_ms=300)
+    assert rc == -2                            # recv timeout mid-line
+    rc, _ = req(_serve_once(b"V " + b"9" * 300 + b"\n"), cap=16)
+    assert rc == -2                            # line overflows the cap
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()
+    rc, _ = req(port, timeout_ms=300)
+    assert rc == -1                            # never connected
+
+
+def test_python_sut_connection_rejects_truncated_reply():
+    """The Python SutConnection has the same contract: a reply missing
+    its newline (server died mid-write) raises TimeoutError instead of
+    handing the workload a fabricated value."""
+    from comdb2_tpu.workloads.tcp import SutConnection
+
+    port = _serve_once(b"V 12")     # partial: real reply was "V 123\n"
+    conn = SutConnection("127.0.0.1", port, timeout_s=1.0)
+    conn.connect()
+    with pytest.raises(TimeoutError, match="truncated"):
+        conn.request("R")
+    conn.close()
