@@ -11,7 +11,7 @@ hung-op behavior the checker must reason about.
 from __future__ import annotations
 
 import socket
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 from ..harness import client as client_ns
 from ..ops.kv import tuple_
@@ -111,7 +111,7 @@ class TcpRegisterClient(client_ns.Client):
                 reply = self.conn.request(f"C {a} {b}")
             else:
                 raise ValueError(f"unknown f {f!r}")
-            if reply == "OK":
+            if reply == "OK" or reply.startswith("OK "):
                 return {**op, "type": "ok"}
             if reply == "FAIL":
                 return {**op, "type": "fail"}
@@ -168,7 +168,10 @@ class TcpClusterRegisterClient(TcpRegisterClient):
                 reply = self.conn.request(f"C {k} {a} {b}")
             else:
                 raise ValueError(f"unknown f {f!r}")
-            if reply == "OK":
+            if reply == "OK" or reply.startswith("OK "):
+                # cluster replies carry the commit LSN ("OK <lsn>") so
+                # HA sessions can cover their own writes; plain clients
+                # only need the ok/fail/indeterminate outcome
                 return {**op, "type": "ok"}
             if reply == "FAIL":
                 return {**op, "type": "fail"}
@@ -196,15 +199,19 @@ class ClusterControl:
             conn.close()
 
     def info(self):
-        """[{node, role, applied, durable}] for reachable nodes;
-        ``durable`` is meaningful on the primary only."""
+        """[{node, role, applied, durable, term, leader}] for reachable
+        nodes; ``durable`` is meaningful on the current primary only."""
         out = []
         for i, port in enumerate(self.ports):
             try:
                 r = self._req(port, "I").split()
-                out.append({"node": int(r[1]), "role": r[2],
-                            "applied": int(r[3]), "durable": int(r[4]),
-                            "port": port})
+                d = {"node": int(r[1]), "role": r[2],
+                     "applied": int(r[3]), "durable": int(r[4]),
+                     "port": port}
+                if len(r) >= 7:
+                    d["term"] = int(r[5])
+                    d["leader"] = int(r[6])
+                out.append(d)
             except (TimeoutError, OSError, IndexError, ValueError):
                 out.append({"node": i, "role": "down", "port": port})
         return out
@@ -296,9 +303,14 @@ class ClusterPartitioner:
 
 
 def spawn_cluster(binary: str, ports, durable: bool = True,
-                  timeout_ms: int = 2000, wait_s: float = 5.0):
+                  timeout_ms: int = 2000, wait_s: float = 5.0,
+                  elect_ms: Optional[int] = None,
+                  lease_ms: Optional[int] = None,
+                  flags: Sequence[str] = ()):
     """Start one ``sut_node`` per port on localhost; returns the list
-    of processes once every node answers PING."""
+    of processes once every node answers PING. ``elect_ms``/``lease_ms``
+    tune the failover timings; ``flags`` passes extra per-node options
+    (e.g. ``["-B"]`` for the split-brain control)."""
     import subprocess
     import time
 
@@ -307,8 +319,13 @@ def spawn_cluster(binary: str, ports, durable: bool = True,
     for i in range(len(ports)):
         args = [binary, "-i", str(i), "-n", plist,
                 "-t", str(timeout_ms)]
+        if elect_ms is not None:
+            args += ["-e", str(elect_ms)]
+        if lease_ms is not None:
+            args += ["-l", str(lease_ms)]
         if not durable:
             args.append("-N")
+        args += list(flags)
         procs.append(subprocess.Popen(args,
                                       stdout=subprocess.DEVNULL,
                                       stderr=subprocess.DEVNULL))

@@ -1,41 +1,57 @@
-/* sut_node — one node of a replicated register/set SUT cluster.
+/* sut_node — one node of a replicated register/set SUT cluster with
+ * leader election.
  *
  * The in-tree stand-in for the reference's 5-node comdb2 cluster in its
  * linearizable configuration (linearizable/linearizable.lrl:1-17):
- * a primary ships a totally-ordered op log to replicas and, in durable
- * mode, acknowledges a write only after a MAJORITY of nodes hold it —
- * the durable-LSN rule of bdb/rep.c:2096 ("client writes aren't done
- * until a majority has them"). `--no-durable` is the negative control:
- * writes are acknowledged after the local apply only, so a partition
- * between primary and replicas yields real stale reads / lost writes
- * that the checker must catch (round-1 Missing #3: partitions could
- * sever client<->server but never produce an anomaly in-tree).
+ * a primary ships a totally-ordered, term-tagged op log to replicas
+ * and, in durable mode, acknowledges a write only after a MAJORITY of
+ * nodes hold it — the durable-LSN rule of bdb/rep.c:2096 ("client
+ * writes aren't done until a majority has them").
  *
- * Topology: all nodes on 127.0.0.1, one port each; node 0 is primary
- * (static — no election; a partitioned durable primary blocks, which is
- * the honest linearizable behavior without leader change).
+ * Election (the role of bdb/rep.c:408-520's vote machinery +
+ * rep.c:429 is_electable): when a node stops hearing from the leader
+ * for its election timeout it campaigns with term+1; peers grant one
+ * vote per term and only to candidates whose (last_term, last_lsn) is
+ * at least as up to date as their own log, so a new leader always
+ * holds every majority-acked write. A leader that loses contact with
+ * a majority for the lease window DEMOTES itself (the coherency-lease
+ * role of bdb/rep.c:639-654) and, in durable mode, refuses local reads
+ * once its lease is stale — a partitioned old primary can neither ack
+ * writes nor serve stale reads. On winning, a leader appends a no-op
+ * entry so the durable LSN can advance in its own term (entries are
+ * only counted toward durability in the term that created them).
+ * Divergent uncommitted suffixes on a rejoining old primary are
+ * truncated by the log-matching check in the replication stream.
+ *
+ * Negative controls:
+ *   --no-durable (-N): writes acked after local apply only — a
+ *     partition yields real stale reads / lost writes.
+ *   --split-brain (-B): a leader that loses quorum neither demotes nor
+ *     waits for majority acks — two primaries accept writes and their
+ *     registers diverge; the checker must flag the history INVALID.
+ *
+ * Topology: all nodes on 127.0.0.1, one port each; node 0 is the
+ * initial leader (term 1) so fault-free startup needs no election.
  *
  * Client protocol (line-based, same shapes as sut_server):
  *   R [k]      -> "V <int>" | "NIL" | "UNKNOWN"   read key k (dflt 1)
- *   W [k] <v>  -> "OK" | "UNKNOWN"                write
- *   C [k] <a> <b> -> "OK" | "FAIL" | "UNKNOWN"    cas
- *   A <v>      -> "OK" | "UNKNOWN"                set add
+ *   W [k] <v>  -> "OK <lsn>" | "UNKNOWN"          write
+ *   C [k] <a> <b> -> "OK <lsn>" | "FAIL" | "UNKNOWN"   cas
+ *   A <v>      -> "OK <lsn>" | "UNKNOWN"          set add
  *   S          -> "V <v1> ..."                    set read (local)
  *   P          -> "PONG"
- *   I          -> "I <id> <role> <applied> <durable>"  cluster info
- *                 (role: primary|replica; <durable> is meaningful on
- *                 the primary only — replicas always report 0)
+ *   I          -> "I <id> <role> <applied> <durable> <term> <leader>"
  *   B <peer>   -> "OK"   drop traffic with node <peer>  (partition)
- *   U <peer>   -> "OK"   heal one peer
- *   U          -> "OK"   heal all
+ *   U <peer>   -> "OK"   heal one peer;  "U" alone heals all
  * Inter-node:
- *   F <from> <cmd...>    forwarded client op (dropped when blocked)
- *   E <from> <lsn> <op...> -> "A <lsn>"        log entry (repl stream)
+ *   F <from> <cmd...>          forwarded client op (dropped if blocked)
+ *   E <from> <term> <lsn> <eterm> <pterm> <op...> -> "A <lsn>" | "N <term>"
+ *   H <from> <term> <durable>  -> "A <applied>" | "N <term>"   heartbeat
+ *   V <from> <term> <last_lsn> <last_term> -> "G <term> <0|1>"  vote req
  *
- * Reads in durable mode forward to the primary (the role of
- * REQUEST_DURABLE_LSN_FROM_MASTER / RETRIEVE_DURABLE_LSN_AT_BEGIN in
- * the lrl); in no-durable mode every node serves its possibly-stale
- * local state.
+ * Mutation replies carry the commit LSN so HA clients can fold their
+ * own acknowledged writes into the snapshot-LSN gate (the cdb2api
+ * snapshot_file/snapshot_lsn role, cdb2api.c:618-656).
  */
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -61,44 +77,98 @@
 
 namespace {
 
+long long mono_ms() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+}
+
 struct LogEntry {
-    char kind;          /* 'W', 'C', 'A' */
-    long long key, a, b;    /* register key (the jepsen register id) */
+    long long term = 0;
+    char kind = 'N';        /* 'W', 'C', 'A', 'N' (no-op) */
+    long long key = 0, a = 0, b = 0;
 };
+
+enum Role { REPLICA = 0, CANDIDATE = 1, PRIMARY = 2 };
 
 struct Node {
     int id = 0;
-    int primary = 0;
     bool durable = true;
+    bool split_brain = false;   /* negative control: never demote */
     int timeout_ms = 2000;      /* durable-LSN wait (lrl:17 = 2000ms) */
+    int hb_ms = 40;             /* heartbeat cadence */
+    int lease_ms = 350;         /* quorum-contact freshness for serving */
+    int elect_ms = 600;         /* election timeout base (+150*id) */
     std::vector<int> ports;
 
     std::mutex mu;
     std::condition_variable cv;
 
-    /* replicated state machine (applied prefix of the log): keyed
-     * registers (the reference's register table rows, id -> val) */
-    long long applied_lsn = 0;
+    /* raft-ish consensus state */
+    Role role = REPLICA;
+    long long term = 1;
+    int voted_for = -1;
+    int leader = -1;
+    long long last_leader_contact = 0;      /* mono_ms */
+
+    /* the replicated log; applied state is always the full log.
+     * regs/set_vals are SPECULATIVE (include uncommitted suffix) —
+     * used for cas preconditions, which is safe because a cas entry
+     * sits after its precondition's entry in the log, so truncation
+     * removes both or neither. Reads must NOT see this state. */
+    std::vector<LogEntry> log;
+    long long applied_lsn = 0;              /* == log.size() */
     std::map<long long, long long> regs;
     std::vector<long long> set_vals;
 
-    /* primary-only: the log + per-replica ack tracking */
-    std::vector<LogEntry> log;               /* log[i] has lsn i+1 */
-    std::vector<long long> acked_upto;       /* per node id */
+    /* the COMMITTED prefix — what reads serve in durable mode. An
+     * applied-but-unacked write must never reach an observer: if it
+     * is later truncated after a failover, the read it escaped into
+     * would make the history non-linearizable (observed, then gone).
+     * This is the durable-LSN read gating of the lrl's
+     * RETRIEVE_DURABLE_LSN_AT_BEGIN. */
+    long long committed_lsn = 0;
+    std::map<long long, long long> committed_regs;
+    std::vector<long long> committed_set;
+
+    /* highest lsn VERIFIED to match the current leader's log (by the
+     * log-matching induction: an entry accepted after its prev-term
+     * check, or a duplicate whose term matches, certifies its whole
+     * prefix). A replica may only commit up to this point: a
+     * heartbeat-learned durable LSN must never commit entries from
+     * our own divergent uncommitted suffix before the E-stream has
+     * repaired it — committed state never rolls back, so that would
+     * be permanent corruption. Resets to committed_lsn on term change. */
+    long long certified_lsn = 0;
+    long long certified_term = 0;
+
+    /* lsn of this leader's election no-op: reads are served only once
+     * durable_lsn reaches it (Raft's new-leader read barrier — before
+     * that, this leader's durable_lsn may lag writes the OLD leader
+     * already acked, and serving would read stale) */
+    long long term_start_lsn = 0;
+
+    /* leader-only: per-peer replication + liveness tracking */
+    std::vector<long long> acked_upto;      /* per node id */
+    std::vector<long long> last_ack;        /* mono_ms of last A reply */
     long long durable_lsn = 0;
+    long long known_durable = 0;            /* replicas: from heartbeats */
 
     /* partition control: peers we drop traffic with */
     std::set<int> blocked;
 
-    bool is_primary() const { return id == primary; }
     size_t majority() const { return ports.size() / 2 + 1; }
+    long long last_log_term() const {
+        return log.empty() ? 0 : log.back().term;
+    }
+    int election_timeout() const { return elect_ms + 150 * id; }
 
     bool blocked_peer(int peer) {
         std::lock_guard<std::mutex> g(mu);
         return blocked.count(peer) != 0;
     }
 
-    /* apply an entry to the local state machine; caller holds mu */
+    /* caller holds mu */
     void apply_locked(const LogEntry &e) {
         if (e.kind == 'W') {
             regs[e.key] = e.a;
@@ -107,22 +177,98 @@ struct Node {
             regs[e.key] = e.b;
         } else if (e.kind == 'A') {
             set_vals.push_back(e.a);
-        }
-        applied_lsn++;
+        }                                   /* 'N' no-op: nothing */
+        applied_lsn = (long long)log.size();
     }
 
+    /* fold newly durable entries into the committed state; the target
+     * is what this node KNOWS is majority-held (its own durable
+     * calculation as leader, heartbeat-learned as replica). Committed
+     * entries can never be truncated (they are in every electable
+     * candidate's log), so this only ever moves forward. */
+    void advance_committed_locked() {
+        long long target =
+            role == PRIMARY ? durable_lsn
+                            : std::min(known_durable, certified_lsn);
+        if (target > (long long)log.size())
+            target = (long long)log.size();
+        while (committed_lsn < target) {
+            const LogEntry &e = log[(size_t)committed_lsn];
+            if (e.kind == 'W')
+                committed_regs[e.key] = e.a;
+            else if (e.kind == 'C')
+                committed_regs[e.key] = e.b;
+            else if (e.kind == 'A')
+                committed_set.push_back(e.a);
+            committed_lsn++;
+        }
+    }
+
+    void append_locked(const LogEntry &e) {
+        log.push_back(e);
+        apply_locked(e);
+    }
+
+    /* drop log entries past lsn and rebuild applied state by replay —
+     * a rejoining old primary's uncommitted divergent suffix dies here
+     * (the log-matching property; those entries were never majority-
+     * acked so no client ever saw OK for them) */
+    void truncate_locked(long long lsn) {
+        if ((long long)log.size() <= lsn) return;
+        log.resize((size_t)lsn);
+        regs.clear();
+        set_vals.clear();
+        applied_lsn = 0;
+        std::vector<LogEntry> entries;
+        entries.swap(log);
+        for (const LogEntry &e : entries) append_locked(e);
+        if (certified_lsn > (long long)log.size())
+            certified_lsn = (long long)log.size();
+    }
+
+    /* caller holds mu. Durable LSN = highest lsn held by a majority
+     * (self included) — but only counted in the term that wrote it
+     * (Raft §5.4.2: a leader only commits entries from its own term by
+     * counting; earlier-term entries commit transitively). The no-op
+     * appended on election win makes this advance promptly. */
     void recompute_durable_locked() {
-        /* durable LSN = highest lsn held by a majority (self included):
-         * sort per-node acked positions, take the majority-th highest —
-         * the durable-LSN calculation of bdb/rep.c:2096 */
         std::vector<long long> pos = acked_upto;
         pos[id] = (long long)log.size();
         std::sort(pos.begin(), pos.end(), std::greater<long long>());
-        long long d = pos[majority() - 1];
-        if (d > durable_lsn) {
-            durable_lsn = d;
+        long long m = pos[majority() - 1];
+        if (m > (long long)log.size())  /* defensive: acks are clamped
+                                         * to certified prefixes, but
+                                         * never index past our log */
+            m = (long long)log.size();
+        if (m > durable_lsn && m >= 1 &&
+            log[(size_t)m - 1].term == term) {
+            durable_lsn = m;
+            advance_committed_locked();
             cv.notify_all();
         }
+    }
+
+    /* caller holds mu: does this (durable-mode) leader currently hold
+     * a fresh majority lease? Measured with MONOTONIC deltas since the
+     * last ack from each peer — immune to wall-clock scrambling. */
+    bool lease_fresh_locked() const {
+        long long now = mono_ms();
+        int fresh = 1;                      /* self */
+        for (size_t p = 0; p < ports.size(); p++)
+            if ((int)p != id && now - last_ack[p] <= lease_ms) fresh++;
+        return fresh >= (int)majority();
+    }
+
+    void step_down_locked(long long new_term) {
+        if (new_term > term) {
+            term = new_term;
+            voted_for = -1;
+        }
+        if (role != REPLICA) {
+            role = REPLICA;
+            leader = -1;
+        }
+        cv.notify_all();
     }
 };
 
@@ -163,7 +309,7 @@ bool send_all(int fd, const std::string &s) {
 }
 
 /* read one '\n'-terminated line (without the newline); false on
- * timeout/eof */
+ * timeout/eof — a line missing its newline is NOT a reply */
 bool read_line(int fd, std::string *out) {
     out->clear();
     char c;
@@ -191,49 +337,72 @@ std::string peer_request(int port, const std::string &line,
     return reply;
 }
 
-/* ---------- replication sender (primary -> one replica) ----------- */
+/* ---------- replication + heartbeat sender (leader -> one peer) ---- */
 
 void sender_thread(int peer) {
     Node &n = g_node;
     int fd = -1;
+    long long last_hb_sent = 0;
     for (;;) {
-        long long next;
-        LogEntry e{};
+        char buf[192];
+        bool have_msg = false;
         {
             std::unique_lock<std::mutex> lk(n.mu);
-            n.cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
-                return n.acked_upto[peer] < (long long)n.log.size() &&
-                       n.blocked.count(peer) == 0;
+            n.cv.wait_for(lk, std::chrono::milliseconds(n.hb_ms), [&] {
+                return n.role == PRIMARY && n.blocked.count(peer) == 0 &&
+                       n.acked_upto[peer] < (long long)n.log.size();
             });
-            if (n.blocked.count(peer) != 0 ||
-                n.acked_upto[peer] >= (long long)n.log.size())
+            if (n.role != PRIMARY || n.blocked.count(peer) != 0)
                 continue;
-            next = n.acked_upto[peer] + 1;
-            e = n.log[(size_t)next - 1];
+            if (n.acked_upto[peer] < (long long)n.log.size()) {
+                long long next = n.acked_upto[peer] + 1;
+                const LogEntry &e = n.log[(size_t)next - 1];
+                long long pterm =
+                    next >= 2 ? n.log[(size_t)next - 2].term : 0;
+                snprintf(buf, sizeof buf,
+                         "E %d %lld %lld %lld %lld %c %lld %lld %lld"
+                         " %lld\n",
+                         n.id, n.term, next, e.term, pterm, e.kind,
+                         e.key, e.a, e.b, n.durable_lsn);
+                have_msg = true;
+            } else if (mono_ms() - last_hb_sent >= n.hb_ms) {
+                snprintf(buf, sizeof buf, "H %d %lld %lld\n", n.id,
+                         n.term, n.durable_lsn);
+                have_msg = true;
+                last_hb_sent = mono_ms();
+            }
         }
+        if (!have_msg) continue;
         if (fd < 0) fd = dial(n.ports[peer], 200);
         if (fd < 0) {
-            /* unreachable replica: back off instead of spinning the
-             * dial loop at 100% CPU (loopback refusals fail in µs) */
+            /* unreachable peer: back off instead of spinning the dial
+             * loop at 100% CPU (loopback refusals fail in µs) */
             std::this_thread::sleep_for(std::chrono::milliseconds(50));
             continue;
         }
-        char buf[160];
-        snprintf(buf, sizeof buf, "E %d %lld %c %lld %lld %lld\n",
-                 n.id, next, e.kind, e.key, e.a, e.b);
         std::string reply;
         if (!send_all(fd, buf) || !read_line(fd, &reply)) {
             close(fd);
             fd = -1;
             continue;
         }
-        long long acked = 0;
-        if (sscanf(reply.c_str(), "A %lld", &acked) == 1) {
+        long long x = 0;
+        if (sscanf(reply.c_str(), "A %lld", &x) == 1) {
             std::lock_guard<std::mutex> g(n.mu);
-            if (acked > n.acked_upto[peer]) {
-                n.acked_upto[peer] = acked;
+            n.last_ack[peer] = mono_ms();
+            if (x > n.acked_upto[peer]) {
+                n.acked_upto[peer] = x;
                 n.recompute_durable_locked();
+            } else if (x < n.acked_upto[peer]) {
+                /* the peer restarted or truncated: regress so the
+                 * stream backfills from its actual position instead of
+                 * offering acked+1 forever (round-2 ADVICE fix) */
+                n.acked_upto[peer] = x;
             }
+        } else if (sscanf(reply.c_str(), "N %lld", &x) == 1) {
+            /* a peer in a newer term: this leader is stale */
+            std::lock_guard<std::mutex> g(n.mu);
+            if (x > n.term) n.step_down_locked(x);
         } else {
             close(fd);
             fd = -1;
@@ -241,68 +410,172 @@ void sender_thread(int peer) {
     }
 }
 
+/* ---------- election ---------------------------------------------- */
+
+/* runs on every node: demotes a leader that lost quorum contact;
+ * campaigns when a replica stops hearing from any leader */
+void election_thread() {
+    Node &n = g_node;
+    for (;;) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(25));
+        long long now = mono_ms();
+        long long t, last_lsn, last_term;
+        std::set<int> blocked_copy;
+        {
+            std::lock_guard<std::mutex> g(n.mu);
+            if (n.role == PRIMARY) {
+                if (!n.split_brain && !n.lease_fresh_locked()) {
+                    /* coherency-lease demotion (bdb/rep.c:639-654):
+                     * without majority contact this leader can't know
+                     * it is still the leader */
+                    n.step_down_locked(n.term);
+                }
+                continue;
+            }
+            if (now - n.last_leader_contact < n.election_timeout())
+                continue;
+            /* campaign */
+            n.term++;
+            n.voted_for = n.id;
+            n.role = CANDIDATE;
+            n.leader = -1;
+            n.last_leader_contact = now;    /* back off before retry */
+            t = n.term;
+            last_lsn = (long long)n.log.size();
+            last_term = n.last_log_term();
+            blocked_copy = n.blocked;
+        }
+        char req[96];
+        snprintf(req, sizeof req, "V %d %lld %lld %lld", n.id, t,
+                 last_lsn, last_term);
+        int votes = 1;
+        for (int p = 0; p < (int)n.ports.size(); p++) {
+            if (p == n.id || blocked_copy.count(p)) continue;
+            std::string r = peer_request(n.ports[p], req, 150);
+            long long gt = 0;
+            int granted = 0;
+            if (sscanf(r.c_str(), "G %lld %d", &gt, &granted) == 2) {
+                if (gt > t) {
+                    std::lock_guard<std::mutex> g(n.mu);
+                    n.step_down_locked(gt);
+                    votes = -1000;
+                    break;
+                }
+                if (granted) votes++;
+            }
+        }
+        std::lock_guard<std::mutex> g(n.mu);
+        if (n.term == t && n.role == CANDIDATE &&
+            votes >= (int)n.majority()) {
+            n.role = PRIMARY;
+            n.leader = n.id;
+            long long nw = mono_ms();
+            for (size_t p = 0; p < n.ports.size(); p++) {
+                n.acked_upto[p] = 0;        /* senders re-probe; acks
+                                             * fast-forward/regress */
+                n.last_ack[p] = nw;         /* lease grace period */
+            }
+            /* the election no-op: lets durable_lsn advance in this
+             * term, transitively committing inherited entries; reads
+             * are barred until it commits (term_start_lsn) */
+            n.append_locked({t, 'N', 0, 0, 0});
+            n.term_start_lsn = (long long)n.log.size();
+            n.recompute_durable_locked();
+            n.cv.notify_all();
+        } else if (n.role == CANDIDATE) {
+            n.role = REPLICA;               /* lost/split: retry after
+                                             * another timeout */
+        }
+    }
+}
+
 /* ---------- request handling -------------------------------------- */
 
-/* primary-side commit: append + apply + (durable) wait for majority.
- * Returns "OK", "FAIL" (cas precondition), or "UNKNOWN" (durable wait
- * timed out: the op is in the log and may still replicate —
+/* leader-side commit: append + apply + (durable) wait for majority.
+ * Returns "OK <lsn>", "FAIL" (cas precondition), or "UNKNOWN" (not
+ * leader / durable wait timed out: the op may still replicate —
  * indeterminate, exactly an :info op). The cas precondition is decided
  * under the same lock as the append, so concurrent cas ops serialize. */
-std::string primary_commit(const LogEntry &e, bool is_cas = false) {
+std::string primary_commit(const LogEntry &e0, bool is_cas = false) {
     Node &n = g_node;
-    long long lsn;
+    LogEntry e = e0;
+    long long lsn, t;
     {
         std::lock_guard<std::mutex> g(n.mu);
+        if (n.role != PRIMARY) return "UNKNOWN";
         if (is_cas) {
             auto it = n.regs.find(e.key);
             if (it == n.regs.end() || it->second != e.a)
                 return "FAIL";
         }
-        n.log.push_back(e);
+        e.term = t = n.term;
+        n.append_locked(e);
         lsn = (long long)n.log.size();
-        n.apply_locked(e);
         n.recompute_durable_locked();
     }
     n.cv.notify_all();
-    if (!n.durable) return "OK";
+    if (!n.durable) return "OK " + std::to_string(lsn);
     std::unique_lock<std::mutex> lk(n.mu);
+    if (n.split_brain && !n.lease_fresh_locked()) {
+        /* the split-brain control: a quorum-less leader acks anyway —
+         * the divergent write the checker must catch */
+        return "OK " + std::to_string(lsn);
+    }
     bool ok = n.cv.wait_for(lk, std::chrono::milliseconds(n.timeout_ms),
-                            [&] { return n.durable_lsn >= lsn; });
-    return ok ? "OK" : "UNKNOWN";
+                            [&] {
+                                return n.durable_lsn >= lsn ||
+                                       n.term != t || n.role != PRIMARY;
+                            });
+    if (ok && n.durable_lsn >= lsn && n.term == t)
+        return "OK " + std::to_string(lsn);
+    return "UNKNOWN";       /* deposed or timed out: indeterminate */
 }
 
-std::string handle(const std::string &line);
+std::string handle(const std::string &line, bool forwarded = false);
 
-/* forward a client op to the primary; both the partition state of this
- * node and the primary's are honored (F carries the origin id). A
- * blocked link behaves like a real partition: the request HANGS until
- * the timeout instead of failing fast — an instant UNKNOWN would let
- * clients machine-gun indeterminate ops (hundreds of forever-pending
- * ops per window make verification itself intractable; real packet
- * drops throttle clients to their timeout cadence). */
-std::string forward_to_primary(const std::string &cmd) {
+/* forward a client op to the current leader; both this node's
+ * partition state and the leader's are honored (F carries the origin
+ * id). A blocked/unknown link behaves like a real partition: the
+ * request HANGS until the timeout instead of failing fast — an
+ * instant UNKNOWN would let clients machine-gun indeterminate ops. */
+std::string forward_to_leader(const std::string &cmd) {
     Node &n = g_node;
-    if (n.blocked_peer(n.primary)) {
+    int ldr;
+    {
+        std::lock_guard<std::mutex> g(n.mu);
+        ldr = n.leader;
+    }
+    if (ldr == n.id) return handle(cmd, /*forwarded=*/true);
+    if (ldr < 0 || n.blocked_peer(ldr)) {
         std::this_thread::sleep_for(
             std::chrono::milliseconds(n.timeout_ms));
         return "UNKNOWN";
     }
-    char buf[160];
+    char buf[192];
     snprintf(buf, sizeof buf, "F %d %s", n.id, cmd.c_str());
-    std::string r = peer_request(n.ports[n.primary], buf, n.timeout_ms);
+    /* the leader's durable wait can take timeout_ms on its own */
+    std::string r =
+        peer_request(n.ports[ldr], buf, n.timeout_ms + 500);
     return r.empty() ? "UNKNOWN" : r;
 }
 
-std::string handle(const std::string &line) {
+const char *role_name(Role r) {
+    return r == PRIMARY ? "primary"
+                        : (r == CANDIDATE ? "candidate" : "replica");
+}
+
+std::string handle(const std::string &line, bool forwarded) {
     Node &n = g_node;
     char cmd = line.empty() ? 0 : line[0];
     if (cmd == 'P') return "PONG";
     if (cmd == 'I') {
         std::lock_guard<std::mutex> g(n.mu);
-        char buf[128];
-        snprintf(buf, sizeof buf, "I %d %s %lld %lld", n.id,
-                 n.is_primary() ? "primary" : "replica", n.applied_lsn,
-                 n.durable_lsn);
+        char buf[160];
+        long long durable =
+            n.role == PRIMARY ? n.durable_lsn : n.known_durable;
+        snprintf(buf, sizeof buf, "I %d %s %lld %lld %lld %d", n.id,
+                 role_name(n.role), n.applied_lsn, durable, n.term,
+                 n.leader);
         return buf;
     }
     if (cmd == 'B' || cmd == 'U') {
@@ -328,59 +601,198 @@ std::string handle(const std::string &line) {
                 std::chrono::milliseconds(n.timeout_ms));
             return "UNKNOWN";
         }
-        return handle(line.substr(1 + (size_t)off));
+        return handle(line.substr(1 + (size_t)off),
+                      /*forwarded=*/true);
     }
-    if (cmd == 'E') {
+    if (cmd == 'H') {
         int from = -1;
-        long long lsn = 0, key = 0, a = 0, b = 0;
-        char kind = 0;
-        if (sscanf(line.c_str() + 1, "%d %lld %c %lld %lld %lld",
-                   &from, &lsn, &kind, &key, &a, &b) != 6)
+        long long hterm = 0, hdurable = 0;
+        if (sscanf(line.c_str() + 1, "%d %lld %lld", &from, &hterm,
+                   &hdurable) != 3)
             return "ERR";
         if (n.blocked_peer(from)) return "ERR";
         std::lock_guard<std::mutex> g(n.mu);
-        if (lsn == n.applied_lsn + 1)
-            n.apply_locked({kind, key, a, b});
-        char buf[64];
-        snprintf(buf, sizeof buf, "A %lld", n.applied_lsn);
-        return buf;
+        if (hterm < n.term) return "N " + std::to_string(n.term);
+        n.step_down_locked(hterm);
+        n.leader = from;
+        n.last_leader_contact = mono_ms();
+        if (hterm != n.certified_term) {
+            n.certified_lsn = n.committed_lsn;
+            n.certified_term = hterm;
+        }
+        if (hdurable > n.known_durable) {
+            n.known_durable = hdurable;
+            n.advance_committed_locked();
+        }
+        /* ack the CERTIFIED prefix, not raw applied: a rejoined node
+         * with a divergent suffix must not have those entries counted
+         * toward durability, and a low ack is what makes the sender
+         * regress and repair the suffix entry by entry */
+        return "A " + std::to_string(n.certified_lsn);
+    }
+    if (cmd == 'V') {
+        int from = -1;
+        long long vterm = 0, vlsn = 0, vlast = 0;
+        if (sscanf(line.c_str() + 1, "%d %lld %lld %lld", &from, &vterm,
+                   &vlsn, &vlast) != 4)
+            return "ERR";
+        if (n.blocked_peer(from)) return "ERR";
+        std::lock_guard<std::mutex> g(n.mu);
+        if (vterm > n.term) n.step_down_locked(vterm);
+        bool up_to_date =
+            vlast > n.last_log_term() ||
+            (vlast == n.last_log_term() &&
+             vlsn >= (long long)n.log.size());
+        bool grant = vterm == n.term &&
+                     (n.voted_for == -1 || n.voted_for == from) &&
+                     up_to_date;
+        if (grant) {
+            n.voted_for = from;
+            n.last_leader_contact = mono_ms();  /* don't also campaign */
+        }
+        return "G " + std::to_string(n.term) + (grant ? " 1" : " 0");
+    }
+    if (cmd == 'E') {
+        int from = -1;
+        long long eterm = 0, lsn = 0, et = 0, pt = 0, key = 0, a = 0,
+                  b = 0, edur = 0;
+        char kind = 0;
+        if (sscanf(line.c_str() + 1,
+                   "%d %lld %lld %lld %lld %c %lld %lld %lld %lld",
+                   &from, &eterm, &lsn, &et, &pt, &kind, &key, &a, &b,
+                   &edur) != 10)
+            return "ERR";
+        if (lsn < 1) return "ERR";  /* log[lsn-1] below would wrap */
+        if (n.blocked_peer(from)) return "ERR";
+        std::lock_guard<std::mutex> g(n.mu);
+        if (eterm < n.term) return "N " + std::to_string(n.term);
+        n.step_down_locked(eterm);
+        n.leader = from;
+        n.last_leader_contact = mono_ms();
+        if (eterm != n.certified_term) {
+            n.certified_lsn = n.committed_lsn;
+            n.certified_term = eterm;
+        }
+        if (edur > n.known_durable) n.known_durable = edur;
+        if (lsn <= n.applied_lsn &&
+            n.log[(size_t)lsn - 1].term != et) {
+            /* conflicting entry from a dead term: drop our suffix */
+            n.truncate_locked(lsn - 1);
+        }
+        if (lsn == n.applied_lsn + 1) {
+            if (lsn >= 2 && n.log[(size_t)lsn - 2].term != pt) {
+                /* previous entry mismatches: force the sender back */
+                n.truncate_locked(lsn - 2);
+            } else {
+                n.append_locked({et, kind, key, a, b});
+            }
+        }
+        if (lsn <= n.applied_lsn &&
+            n.log[(size_t)lsn - 1].term == et && lsn > n.certified_lsn) {
+            /* matching index+term certifies the whole prefix (the
+             * log-matching property) — commits may now cover it */
+            n.certified_lsn = lsn;
+        }
+        /* ack the certified prefix (see the H handler): the sender
+         * fast-forwards over verified matches or regresses into our
+         * divergent suffix to repair it */
+        n.advance_committed_locked();
+        return "A " + std::to_string(n.certified_lsn);
     }
     if (cmd == 'R') {
         long long key = 1;                  /* "R" alone = key 1 */
         sscanf(line.c_str() + 1, "%lld", &key);
-        if (n.durable && !n.is_primary())
-            return forward_to_primary("R " + std::to_string(key));
-        std::lock_guard<std::mutex> g(n.mu);
-        auto it = n.regs.find(key);
-        return it != n.regs.end() ? "V " + std::to_string(it->second)
-                                  : "NIL";
+        bool am_leader, speculative;
+        {
+            std::lock_guard<std::mutex> g(n.mu);
+            am_leader = n.role == PRIMARY;
+            if (!n.durable) {
+                /* no-durable control: every node serves its possibly
+                 * stale, possibly uncommitted local state */
+                auto it = n.regs.find(key);
+                return it != n.regs.end()
+                           ? "V " + std::to_string(it->second)
+                           : "NIL";
+            }
+            /* leader-only: on -B replicas last_ack never refreshes, so
+             * without the am_leader gate every replica would serve
+             * stale local state (degenerating this control into -N) */
+            speculative = am_leader && n.split_brain &&
+                          !n.lease_fresh_locked();
+            if (am_leader && !speculative) {
+                /* durable-mode leader read: needs a fresh majority
+                 * lease AND the term's no-op committed (before that,
+                 * our durable_lsn may lag writes the old leader acked)
+                 * — then serve the COMMITTED prefix only: an applied-
+                 * but-unacked write must never escape to an observer,
+                 * it could be truncated after a failover */
+                if (n.lease_fresh_locked() &&
+                    n.durable_lsn >= n.term_start_lsn) {
+                    auto it = n.committed_regs.find(key);
+                    return it != n.committed_regs.end()
+                               ? "V " + std::to_string(it->second)
+                               : "NIL";
+                }
+            } else if (speculative) {
+                /* the split-brain control serves its divergent
+                 * speculative state off the stale lease — the
+                 * anomaly has to be client-visible */
+                auto it = n.regs.find(key);
+                return it != n.regs.end()
+                           ? "V " + std::to_string(it->second)
+                           : "NIL";
+            }
+        }
+        if (!am_leader && !forwarded)
+            return forward_to_leader("R " + std::to_string(key));
+        /* lease-stale/barred leader (or a forward that raced a
+         * deposition): hang like a partition — serving here is
+         * exactly the stale read the lease prevents */
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(n.timeout_ms));
+        return "UNKNOWN";
     }
     if (cmd == 'S') {
         std::lock_guard<std::mutex> g(n.mu);
+        /* durable mode: only the committed prefix — an uncommitted
+         * set element could be truncated after failover, and a reader
+         * that saw it would report a "flickering" element */
+        const std::vector<long long> &vals =
+            n.durable ? n.committed_set : n.set_vals;
         std::string out = "V";
-        for (long long v : n.set_vals) out += " " + std::to_string(v);
+        for (long long v : vals) out += " " + std::to_string(v);
         return out;
     }
     if (cmd == 'W' || cmd == 'C' || cmd == 'A') {
-        if (!n.is_primary()) return forward_to_primary(line);
+        bool am_leader;
+        {
+            std::lock_guard<std::mutex> g(n.mu);
+            am_leader = n.role == PRIMARY;
+        }
+        if (!am_leader) {
+            /* a forwarded mutation that raced a deposition must not
+             * bounce around the cluster: indeterminate, client retries */
+            if (forwarded) return "UNKNOWN";
+            return forward_to_leader(line);
+        }
         if (cmd == 'W') {
             /* "W k v" keyed; "W v" = key 1 (sut_server compatible) */
             long long k = 0, v = 0;
             int cnt = sscanf(line.c_str() + 1, "%lld %lld", &k, &v);
             if (cnt == 1) { v = k; k = 1; }
             else if (cnt != 2) return "ERR";
-            return primary_commit({'W', k, v, 0});
+            return primary_commit({0, 'W', k, v, 0});
         }
         if (cmd == 'A') {
             long long v = atoll(line.c_str() + 1);
-            return primary_commit({'A', 0, v, 0});
+            return primary_commit({0, 'A', 0, v, 0});
         }
         /* "C k a b" keyed; "C a b" = key 1 */
         long long k = 0, a = 0, b = 0;
         int cnt = sscanf(line.c_str() + 1, "%lld %lld %lld", &k, &a, &b);
         if (cnt == 2) { b = a; a = k; k = 1; }
         else if (cnt != 3) return "ERR";
-        return primary_commit({'C', k, a, b}, /*is_cas=*/true);
+        return primary_commit({0, 'C', k, a, b}, /*is_cas=*/true);
     }
     return "ERR";
 }
@@ -407,18 +819,24 @@ void serve_conn(int fd) {
 int main(int argc, char **argv) {
     Node &n = g_node;
     std::string peers;
+    int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:Nh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:NBh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
-        case 'P': n.primary = atoi(optarg); break;
+        case 'P': initial_leader = atoi(optarg); break;
         case 't': n.timeout_ms = atoi(optarg); break;
+        case 'e': n.elect_ms = atoi(optarg); break;
+        case 'l': n.lease_ms = atoi(optarg); break;
         case 'N': n.durable = false; break;
+        case 'B': n.split_brain = true; break;
         default:
             fprintf(stderr,
-                    "usage: %s -i id -n port0,port1,... [-P primary] "
-                    "[-t durable_timeout_ms] [-N (no-durable)]\n",
+                    "usage: %s -i id -n port0,port1,... [-P leader0] "
+                    "[-t durable_timeout_ms] [-e elect_base_ms] "
+                    "[-l lease_ms] [-N (no-durable)] "
+                    "[-B (split-brain control)]\n",
                     argv[0]);
             return 2;
         }
@@ -434,7 +852,18 @@ int main(int argc, char **argv) {
         fprintf(stderr, "sut_node: bad -i/-n\n");
         return 2;
     }
+    if (n.lease_ms >= n.elect_ms) {
+        /* reads are only lease-safe when every leader demotes before
+         * any replica can start a new election (the Raft lease-read
+         * requirement) */
+        fprintf(stderr, "sut_node: lease_ms must be < elect_ms\n");
+        return 2;
+    }
     n.acked_upto.assign(n.ports.size(), 0);
+    n.last_ack.assign(n.ports.size(), mono_ms());
+    n.leader = initial_leader;
+    n.role = n.id == initial_leader ? PRIMARY : REPLICA;
+    n.last_leader_contact = mono_ms();
     signal(SIGPIPE, SIG_IGN);
 
     int srv = socket(AF_INET, SOCK_STREAM, 0);
@@ -449,14 +878,13 @@ int main(int argc, char **argv) {
         perror("bind/listen");
         return 2;
     }
-    if (n.is_primary()) {
-        for (int peer = 0; peer < (int)n.ports.size(); peer++)
-            if (peer != n.id)
-                std::thread(sender_thread, peer).detach();
-    }
+    /* every node runs senders; they idle unless this node leads */
+    for (int peer = 0; peer < (int)n.ports.size(); peer++)
+        if (peer != n.id) std::thread(sender_thread, peer).detach();
+    std::thread(election_thread).detach();
     fprintf(stderr, "sut_node %d (%s, %s) on 127.0.0.1:%d\n", n.id,
-            n.is_primary() ? "primary" : "replica",
-            n.durable ? "durable" : "no-durable", n.ports[n.id]);
+            role_name(n.role), n.durable ? "durable" : "no-durable",
+            n.ports[n.id]);
 
     for (;;) {
         int fd = accept(srv, nullptr, nullptr);

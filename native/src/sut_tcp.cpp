@@ -68,13 +68,24 @@ long long node_applied(sut_tcp *t) {
 }
 
 /* mutating op: sticky node, retry-elsewhere ONLY on clean connect
- * failure, indeterminate once the request may have been delivered */
+ * failure, indeterminate once the request may have been delivered.
+ * An acked mutation's commit LSN (the "OK <lsn>" reply) folds into
+ * the session's snapshot LSN so this session's own writes are covered
+ * by the reads-never-go-backwards gate — the cdb2api behavior of
+ * advancing snapshot_lsn on committed writes (cdb2api.c:618-656). */
 int mutate(sut_tcp *t, const std::string &line) {
     char reply[128];
     for (int attempt = 0; attempt < t->max_retries; attempt++) {
         int rc = node_request(t, line, reply, sizeof reply);
         if (rc == 0) {
-            if (strcmp(reply, "OK") == 0) return SUT_OK;
+            if (strncmp(reply, "OK", 2) == 0 &&
+                (reply[2] == 0 || reply[2] == ' ')) {
+                long long lsn = 0;
+                if (sscanf(reply + 2, "%lld", &lsn) == 1 &&
+                    lsn > t->seen_lsn)
+                    t->seen_lsn = lsn;
+                return SUT_OK;
+            }
             if (strcmp(reply, "FAIL") == 0) return SUT_FAIL;
             return SUT_UNKNOWN;
         }
