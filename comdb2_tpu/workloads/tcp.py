@@ -125,20 +125,68 @@ class TcpClusterRegisterClient(TcpRegisterClient):
     worker talks to one node (cycled), so reads land on replicas and a
     partition between nodes is visible to the checker — the client-side
     shape of the reference's 5-node register test
-    (``comdb2/core.clj:567-613``)."""
+    (``comdb2/core.clj:567-613``).
 
-    def __init__(self, ports, timeout_s: float = 1.0):
+    Mutations ride replay nonces (``M <nonce> <cmd>``): an attempt whose
+    outcome was lost is retried on the next node, and a node that
+    already applied it replays the recorded outcome — the cdb2api HA
+    retry backed by blkseq dedup. Only an exhausted retry budget
+    surfaces as an indeterminate ``info`` op."""
+
+    def __init__(self, ports, timeout_s: float = 1.0,
+                 mutate_retries: int = 3):
         super().__init__("127.0.0.1", ports[0], timeout_s)
         self.ports = list(ports)
         self._next = 0
+        self.mutate_retries = mutate_retries
+        self._session = None
+        self._seq = 0
+        self._port_ix = 0
 
     def setup(self, test, node):
-        port = self.ports[self._next % len(self.ports)]
+        import random as _random
+
+        port_ix = self._next % len(self.ports)
         self._next += 1
-        c = TcpClusterRegisterClient(self.ports, self.timeout_s)
-        c.conn = SutConnection(self.host, port, self.timeout_s)
+        c = TcpClusterRegisterClient(self.ports, self.timeout_s,
+                                     self.mutate_retries)
+        c._port_ix = port_ix
+        c._session = _random.SystemRandom().getrandbits(32)
+        c.conn = SutConnection(self.host, self.ports[port_ix],
+                               self.timeout_s)
         c.conn.connect()
         return c
+
+    def _rotate(self) -> None:
+        """Reconnect to the next node (retry-elsewhere)."""
+        self._port_ix = (self._port_ix + 1) % len(self.ports)
+        self.conn.close()
+        self.conn = SutConnection(self.host, self.ports[self._port_ix],
+                                  self.timeout_s)
+
+    def _mutate(self, cmd: str) -> str:
+        """Send one nonce-wrapped mutation with retry-elsewhere;
+        returns the final reply ("UNKNOWN" when the budget exhausts)."""
+        self._seq += 1
+        nonce = (self._session << 24) | self._seq
+        line = f"M {nonce} {cmd}"
+        maybe_delivered = False
+        for _ in range(self.mutate_retries):
+            try:
+                reply = self.conn.request(line)
+            except TimeoutError:
+                maybe_delivered = True      # sent, no complete reply
+                self._rotate()
+                continue
+            except OSError:
+                self._rotate()              # never connected: safe
+                continue
+            if reply.startswith("OK") or reply == "FAIL":
+                return reply
+            maybe_delivered = True      # delivered, outcome unresolved
+            self._rotate()
+        # FAIL is only safe when no attempt can have been delivered
+        return "UNKNOWN" if maybe_delivered else "FAIL"
 
     def invoke(self, test, op):
         """Keyed commands (``R k`` / ``W k v`` / ``C k a b``): the
@@ -146,38 +194,32 @@ class TcpClusterRegisterClient(TcpRegisterClient):
         register table, and the independent checker verifies per key."""
         f = op["f"]
         k, v = op["value"] if op["value"] is not None else (1, None)
-        try:
-            if f == "read":
-                # reads have no side effects, so any failure is safely
-                # :fail (never pends) — an info read would stay pending
-                # forever and pending ops are what blow up the checker
-                try:
-                    reply = self.conn.request(f"R {k}")
-                except TimeoutError:
-                    return {**op, "type": "fail"}
-                if reply == "NIL":
-                    return {**op, "type": "ok", "value": tuple_(k, None)}
-                if reply.startswith("V "):
-                    return {**op, "type": "ok",
-                            "value": tuple_(k, int(reply[2:]))}
+        if f == "read":
+            # reads have no side effects, so any failure is safely
+            # :fail (never pends) — an info read would stay pending
+            # forever and pending ops are what blow up the checker
+            try:
+                reply = self.conn.request(f"R {k}")
+            except (TimeoutError, OSError):
                 return {**op, "type": "fail"}
-            if f == "write":
-                reply = self.conn.request(f"W {k} {v}")
-            elif f == "cas":
-                a, b = v
-                reply = self.conn.request(f"C {k} {a} {b}")
-            else:
-                raise ValueError(f"unknown f {f!r}")
-            if reply == "OK" or reply.startswith("OK "):
-                # cluster replies carry the commit LSN ("OK <lsn>") so
-                # HA sessions can cover their own writes; plain clients
-                # only need the ok/fail/indeterminate outcome
-                return {**op, "type": "ok"}
-            if reply == "FAIL":
-                return {**op, "type": "fail"}
-            return {**op, "type": "info", "error": reply}
-        except TimeoutError as e:
-            return {**op, "type": "info", "error": str(e)}
+            if reply == "NIL":
+                return {**op, "type": "ok", "value": tuple_(k, None)}
+            if reply.startswith("V "):
+                return {**op, "type": "ok",
+                        "value": tuple_(k, int(reply[2:]))}
+            return {**op, "type": "fail"}
+        if f == "write":
+            reply = self._mutate(f"W {k} {v}")
+        elif f == "cas":
+            a, b = v
+            reply = self._mutate(f"C {k} {a} {b}")
+        else:
+            raise ValueError(f"unknown f {f!r}")
+        if reply.startswith("OK"):
+            return {**op, "type": "ok"}
+        if reply == "FAIL":
+            return {**op, "type": "fail"}
+        return {**op, "type": "info", "error": reply}
 
 
 class ClusterControl:

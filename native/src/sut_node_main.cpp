@@ -38,6 +38,13 @@
  *   W [k] <v>  -> "OK <lsn>" | "UNKNOWN"          write
  *   C [k] <a> <b> -> "OK <lsn>" | "FAIL" | "UNKNOWN"   cas
  *   A <v>      -> "OK <lsn>" | "UNKNOWN"          set add
+ *   M <nonce> <W|C|A ...> -> same replies         retry-safe mutation:
+ *                 the nonce is logged with the entry (replicated, like
+ *                 bdb blkseq), so a retried request that already
+ *                 applied returns its recorded outcome instead of
+ *                 re-executing; --no-dedup (-D) disables the lookup —
+ *                 the negative control where a retried cas re-executes
+ *                 and double-applies
  *   S          -> "V <v1> ..."                    set read (local)
  *   P          -> "PONG"
  *   I          -> "I <id> <role> <applied> <durable> <term> <leader>"
@@ -87,6 +94,7 @@ struct LogEntry {
     long long term = 0;
     char kind = 'N';        /* 'W', 'C', 'A', 'N' (no-op) */
     long long key = 0, a = 0, b = 0;
+    unsigned long long nonce = 0;   /* client replay nonce; 0 = none */
 };
 
 enum Role { REPLICA = 0, CANDIDATE = 1, PRIMARY = 2 };
@@ -95,6 +103,7 @@ struct Node {
     int id = 0;
     bool durable = true;
     bool split_brain = false;   /* negative control: never demote */
+    bool no_dedup = false;      /* negative control: replay re-executes */
     int timeout_ms = 2000;      /* durable-LSN wait (lrl:17 = 2000ms) */
     int hb_ms = 40;             /* heartbeat cadence */
     int lease_ms = 350;         /* quorum-contact freshness for serving */
@@ -154,6 +163,14 @@ struct Node {
     long long durable_lsn = 0;
     long long known_durable = 0;            /* replicas: from heartbeats */
 
+    /* replay dedup: nonce -> lsn of the entry that applied it. Lives
+     * IN the log (entries carry their nonce), so every replica
+     * rebuilds it on apply and it survives failover exactly as far as
+     * the entry itself does — the bdb_blkseq role: a retried mutation
+     * that already applied returns its recorded outcome instead of
+     * re-executing (cdb2api.c:618-656 retries lean on this). */
+    std::map<unsigned long long, long long> nonce_lsn;
+
     /* partition control: peers we drop traffic with */
     std::set<int> blocked;
 
@@ -179,6 +196,7 @@ struct Node {
             set_vals.push_back(e.a);
         }                                   /* 'N' no-op: nothing */
         applied_lsn = (long long)log.size();
+        if (e.nonce != 0) nonce_lsn[e.nonce] = applied_lsn;
     }
 
     /* fold newly durable entries into the committed state; the target
@@ -218,6 +236,7 @@ struct Node {
         log.resize((size_t)lsn);
         regs.clear();
         set_vals.clear();
+        nonce_lsn.clear();
         applied_lsn = 0;
         std::vector<LogEntry> entries;
         entries.swap(log);
@@ -361,9 +380,9 @@ void sender_thread(int peer) {
                     next >= 2 ? n.log[(size_t)next - 2].term : 0;
                 snprintf(buf, sizeof buf,
                          "E %d %lld %lld %lld %lld %c %lld %lld %lld"
-                         " %lld\n",
+                         " %lld %llu\n",
                          n.id, n.term, next, e.term, pterm, e.kind,
-                         e.key, e.a, e.b, n.durable_lsn);
+                         e.key, e.a, e.b, n.durable_lsn, e.nonce);
                 have_msg = true;
             } else if (mono_ms() - last_hb_sent >= n.hb_ms) {
                 snprintf(buf, sizeof buf, "H %d %lld %lld\n", n.id,
@@ -500,18 +519,34 @@ std::string primary_commit(const LogEntry &e0, bool is_cas = false) {
     Node &n = g_node;
     LogEntry e = e0;
     long long lsn, t;
+    bool replay = false;
     {
         std::lock_guard<std::mutex> g(n.mu);
         if (n.role != PRIMARY) return "UNKNOWN";
-        if (is_cas) {
-            auto it = n.regs.find(e.key);
-            if (it == n.regs.end() || it->second != e.a)
-                return "FAIL";
+        /* replay dedup, atomically with the append decision: a
+         * retried mutation whose entry is already in the log waits on
+         * THAT entry instead of applying twice. Only applied ops are
+         * logged, so a precondition-FAILed cas re-executes fresh —
+         * its first attempt had no effect, exactly-once holds. */
+        if (e.nonce != 0 && !n.no_dedup) {
+            auto it = n.nonce_lsn.find(e.nonce);
+            if (it != n.nonce_lsn.end()) {
+                lsn = it->second;
+                t = n.log[(size_t)lsn - 1].term;
+                replay = true;
+            }
         }
-        e.term = t = n.term;
-        n.append_locked(e);
-        lsn = (long long)n.log.size();
-        n.recompute_durable_locked();
+        if (!replay) {
+            if (is_cas) {
+                auto it = n.regs.find(e.key);
+                if (it == n.regs.end() || it->second != e.a)
+                    return "FAIL";
+            }
+            e.term = t = n.term;
+            n.append_locked(e);
+            lsn = (long long)n.log.size();
+            n.recompute_durable_locked();
+        }
     }
     n.cv.notify_all();
     if (!n.durable) return "OK " + std::to_string(lsn);
@@ -520,6 +555,19 @@ std::string primary_commit(const LogEntry &e0, bool is_cas = false) {
         /* the split-brain control: a quorum-less leader acks anyway —
          * the divergent write the checker must catch */
         return "OK " + std::to_string(lsn);
+    }
+    if (replay) {
+        /* the entry may have committed under ANY term (inherited by a
+         * later leader): only durable coverage matters */
+        bool ok = n.cv.wait_for(lk,
+                                std::chrono::milliseconds(n.timeout_ms),
+                                [&] {
+                                    return n.durable_lsn >= lsn ||
+                                           n.role != PRIMARY;
+                                });
+        if (ok && n.durable_lsn >= lsn)
+            return "OK " + std::to_string(lsn);
+        return "UNKNOWN";
     }
     bool ok = n.cv.wait_for(lk, std::chrono::milliseconds(n.timeout_ms),
                             [&] {
@@ -656,11 +704,12 @@ std::string handle(const std::string &line, bool forwarded) {
         int from = -1;
         long long eterm = 0, lsn = 0, et = 0, pt = 0, key = 0, a = 0,
                   b = 0, edur = 0;
+        unsigned long long enonce = 0;
         char kind = 0;
         if (sscanf(line.c_str() + 1,
-                   "%d %lld %lld %lld %lld %c %lld %lld %lld %lld",
+                   "%d %lld %lld %lld %lld %c %lld %lld %lld %lld %llu",
                    &from, &eterm, &lsn, &et, &pt, &kind, &key, &a, &b,
-                   &edur) != 10)
+                   &edur, &enonce) < 10)
             return "ERR";
         if (lsn < 1) return "ERR";  /* log[lsn-1] below would wrap */
         if (n.blocked_peer(from)) return "ERR";
@@ -684,7 +733,7 @@ std::string handle(const std::string &line, bool forwarded) {
                 /* previous entry mismatches: force the sender back */
                 n.truncate_locked(lsn - 2);
             } else {
-                n.append_locked({et, kind, key, a, b});
+                n.append_locked({et, kind, key, a, b, enonce});
             }
         }
         if (lsn <= n.applied_lsn &&
@@ -763,7 +812,21 @@ std::string handle(const std::string &line, bool forwarded) {
         for (long long v : vals) out += " " + std::to_string(v);
         return out;
     }
-    if (cmd == 'W' || cmd == 'C' || cmd == 'A') {
+    if (cmd == 'M' || cmd == 'W' || cmd == 'C' || cmd == 'A') {
+        unsigned long long nonce = 0;
+        std::string inner = line;
+        if (cmd == 'M') {
+            /* "M <nonce> <W|C|A ...>": a retry-safe mutation */
+            int off = 0;
+            if (sscanf(line.c_str() + 1, "%llu %n", &nonce, &off) < 1 ||
+                nonce == 0)
+                return "ERR";
+            inner = line.substr(1 + (size_t)off);
+            if (inner.empty())
+                return "ERR";
+            cmd = inner[0];
+            if (cmd != 'W' && cmd != 'C' && cmd != 'A') return "ERR";
+        }
         bool am_leader;
         {
             std::lock_guard<std::mutex> g(n.mu);
@@ -773,26 +836,28 @@ std::string handle(const std::string &line, bool forwarded) {
             /* a forwarded mutation that raced a deposition must not
              * bounce around the cluster: indeterminate, client retries */
             if (forwarded) return "UNKNOWN";
-            return forward_to_leader(line);
+            return forward_to_leader(line);    /* nonce rides along */
         }
         if (cmd == 'W') {
             /* "W k v" keyed; "W v" = key 1 (sut_server compatible) */
             long long k = 0, v = 0;
-            int cnt = sscanf(line.c_str() + 1, "%lld %lld", &k, &v);
+            int cnt = sscanf(inner.c_str() + 1, "%lld %lld", &k, &v);
             if (cnt == 1) { v = k; k = 1; }
             else if (cnt != 2) return "ERR";
-            return primary_commit({0, 'W', k, v, 0});
+            return primary_commit({0, 'W', k, v, 0, nonce});
         }
         if (cmd == 'A') {
-            long long v = atoll(line.c_str() + 1);
-            return primary_commit({0, 'A', 0, v, 0});
+            long long v = atoll(inner.c_str() + 1);
+            return primary_commit({0, 'A', 0, v, 0, nonce});
         }
         /* "C k a b" keyed; "C a b" = key 1 */
         long long k = 0, a = 0, b = 0;
-        int cnt = sscanf(line.c_str() + 1, "%lld %lld %lld", &k, &a, &b);
+        int cnt = sscanf(inner.c_str() + 1, "%lld %lld %lld", &k, &a,
+                         &b);
         if (cnt == 2) { b = a; a = k; k = 1; }
         else if (cnt != 3) return "ERR";
-        return primary_commit({0, 'C', k, a, b}, /*is_cas=*/true);
+        return primary_commit({0, 'C', k, a, b, nonce},
+                              /*is_cas=*/true);
     }
     return "ERR";
 }
@@ -821,7 +886,7 @@ int main(int argc, char **argv) {
     std::string peers;
     int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:e:l:NBh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:NBDh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
@@ -831,12 +896,14 @@ int main(int argc, char **argv) {
         case 'l': n.lease_ms = atoi(optarg); break;
         case 'N': n.durable = false; break;
         case 'B': n.split_brain = true; break;
+        case 'D': n.no_dedup = true; break;
         default:
             fprintf(stderr,
                     "usage: %s -i id -n port0,port1,... [-P leader0] "
                     "[-t durable_timeout_ms] [-e elect_base_ms] "
                     "[-l lease_ms] [-N (no-durable)] "
-                    "[-B (split-brain control)]\n",
+                    "[-B (split-brain control)] "
+                    "[-D (no-dedup control)]\n",
                     argv[0]);
             return 2;
         }

@@ -12,6 +12,10 @@
  *   A <v>        -> "OK"                     (set add)
  *   S            -> "V <v1> <v2> ..."        (set read)
  *   P            -> "PONG"                   (health)
+ *   M <nonce> <W|C|A ...> -> same replies    (retry-safe mutation:
+ *                  a nonce whose op already resolved OK/FAIL replays
+ *                  the recorded reply — the single-node blkseq shape
+ *                  the HA client's retries rely on)
  * Flags: -p port (default 7777), -F flaky, -B buggy, -s seed.
  */
 #include "comdb2_tpu/sut.h"
@@ -28,11 +32,82 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 namespace {
+
+/* replay dedup across ALL connections (sut_mem state is process-
+ * global, so the dedup must be too): nonce -> recorded OK/FAIL reply.
+ * UNKNOWN outcomes are unresolved and not recorded — their retry
+ * re-executes. Held across the execute so concurrent same-nonce
+ * retries serialize. */
+std::mutex g_nonce_mu;
+std::map<unsigned long long, std::string> g_nonce_reply;
+
+std::string handle_cmd(sut_handle *h, const char *line) {
+    char cmd = line[0];
+    if (cmd == 'P') return "PONG\n";
+    if (cmd == 'R') {
+        int v = 0, found = 0;
+        int rc = sut_reg_read(h, &v, &found);
+        if (rc == SUT_OK)
+            return found ? ("V " + std::to_string(v) + "\n") : "NIL\n";
+        return "FAIL\n";
+    }
+    if (cmd == 'W') {
+        int v = atoi(line + 1);
+        int rc = sut_reg_write(h, v);
+        return rc == SUT_OK ? "OK\n"
+             : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
+    }
+    if (cmd == 'C') {
+        int a = 0, b = 0;
+        if (sscanf(line + 1, "%d %d", &a, &b) != 2) return "ERR\n";
+        int rc = sut_reg_cas(h, a, b);
+        return rc == SUT_OK ? "OK\n"
+             : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
+    }
+    if (cmd == 'A') {
+        long long v = atoll(line + 1);
+        int rc = sut_set_add(h, v);
+        return rc == SUT_OK ? "OK\n"
+             : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
+    }
+    if (cmd == 'S') {
+        long long *vals = nullptr;
+        size_t n = 0;
+        if (sut_set_read(h, &vals, &n) == SUT_OK) {
+            std::string out = "V";
+            for (size_t i = 0; i < n; i++)
+                out += " " + std::to_string(vals[i]);
+            out += "\n";
+            free(vals);
+            return out;
+        }
+        return "FAIL\n";
+    }
+    if (cmd == 'M') {
+        unsigned long long nonce = 0;
+        int off = 0;
+        if (sscanf(line + 1, "%llu %n", &nonce, &off) < 1 ||
+            nonce == 0)
+            return "ERR\n";
+        const char *inner = line + 1 + off;
+        if (*inner != 'W' && *inner != 'C' && *inner != 'A')
+            return "ERR\n";
+        std::lock_guard<std::mutex> g(g_nonce_mu);
+        auto it = g_nonce_reply.find(nonce);
+        if (it != g_nonce_reply.end()) return it->second;
+        std::string r = handle_cmd(h, inner);
+        if (r == "OK\n" || r == "FAIL\n") g_nonce_reply[nonce] = r;
+        return r;
+    }
+    return "ERR\n";
+}
 
 void serve_conn(int fd, uint32_t flags, unsigned seed) {
     sut_handle *h = sut_open(nullptr, flags, seed);
@@ -45,51 +120,7 @@ void serve_conn(int fd, uint32_t flags, unsigned seed) {
     char line[256];
     std::string out;
     while (fgets(line, sizeof line, in) != nullptr) {
-        out.clear();
-        char cmd = line[0];
-        if (cmd == 'P') {
-            out = "PONG\n";
-        } else if (cmd == 'R') {
-            int v = 0, found = 0;
-            int rc = sut_reg_read(h, &v, &found);
-            if (rc == SUT_OK)
-                out = found ? ("V " + std::to_string(v) + "\n") : "NIL\n";
-            else
-                out = "FAIL\n";
-        } else if (cmd == 'W') {
-            int v = atoi(line + 1);
-            int rc = sut_reg_write(h, v);
-            out = rc == SUT_OK ? "OK\n"
-                : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
-        } else if (cmd == 'C') {
-            int a = 0, b = 0;
-            if (sscanf(line + 1, "%d %d", &a, &b) != 2) {
-                out = "ERR\n";
-            } else {
-                int rc = sut_reg_cas(h, a, b);
-                out = rc == SUT_OK ? "OK\n"
-                    : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
-            }
-        } else if (cmd == 'A') {
-            long long v = atoll(line + 1);
-            int rc = sut_set_add(h, v);
-            out = rc == SUT_OK ? "OK\n"
-                : rc == SUT_FAIL ? "FAIL\n" : "UNKNOWN\n";
-        } else if (cmd == 'S') {
-            long long *vals = nullptr;
-            size_t n = 0;
-            if (sut_set_read(h, &vals, &n) == SUT_OK) {
-                out = "V";
-                for (size_t i = 0; i < n; i++)
-                    out += " " + std::to_string(vals[i]);
-                out += "\n";
-                free(vals);
-            } else {
-                out = "FAIL\n";
-            }
-        } else {
-            out = "ERR\n";
-        }
+        out = handle_cmd(h, line);
         /* loop: a short write (signal interruption, full send buffer
          * on a large set-read reply) would desync the line protocol */
         size_t off = 0;

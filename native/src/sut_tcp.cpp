@@ -18,11 +18,13 @@
 #include "comdb2_tpu/sut_tcp.h"
 #include "comdb2_tpu/testutil.h"
 
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <random>
 #include <string>
+#include <thread>
 #include <vector>
 
 struct sut_tcp {
@@ -33,6 +35,8 @@ struct sut_tcp {
     int max_retries = 5;            /* nodes tried per op */
     long long seen_lsn = 0;         /* snapshot tracking */
     size_t cur = 0;                 /* current node (sticky) */
+    unsigned long long session = 0; /* high nonce bits (random) */
+    unsigned long long op_seq = 0;  /* low nonce bits (per op) */
 };
 
 namespace {
@@ -67,16 +71,26 @@ long long node_applied(sut_tcp *t) {
     return -1;
 }
 
-/* mutating op: sticky node, retry-elsewhere ONLY on clean connect
- * failure, indeterminate once the request may have been delivered.
+/* mutating op, retry-safe via replay nonces (the cdb2api HA retry +
+ * bdb blkseq pairing, cdb2api.c:618-656): every mutation is sent as
+ * "M <nonce> <cmd>" with a session-unique nonce, so a request whose
+ * outcome was lost (timeout, failover, durable-wait UNKNOWN) can be
+ * RETRIED ELSEWHERE — a node that already applied it replays the
+ * recorded outcome instead of double-applying. Only when the retry
+ * budget exhausts with a possibly-delivered attempt outstanding does
+ * the op surface as indeterminate; before nonces every such attempt
+ * was an instant UNKNOWN and fault-window histories drowned in
+ * forever-pending info ops.
  * An acked mutation's commit LSN (the "OK <lsn>" reply) folds into
  * the session's snapshot LSN so this session's own writes are covered
- * by the reads-never-go-backwards gate — the cdb2api behavior of
- * advancing snapshot_lsn on committed writes (cdb2api.c:618-656). */
+ * by the reads-never-go-backwards gate. */
 int mutate(sut_tcp *t, const std::string &line) {
-    char reply[128];
+    char reply[192];
+    unsigned long long nonce = (t->session << 24) | ++t->op_seq;
+    std::string msg = "M " + std::to_string(nonce) + " " + line;
+    bool maybe_delivered = false;
     for (int attempt = 0; attempt < t->max_retries; attempt++) {
-        int rc = node_request(t, line, reply, sizeof reply);
+        int rc = node_request(t, msg, reply, sizeof reply);
         if (rc == 0) {
             if (strncmp(reply, "OK", 2) == 0 &&
                 (reply[2] == 0 || reply[2] == ' ')) {
@@ -87,12 +101,21 @@ int mutate(sut_tcp *t, const std::string &line) {
                 return SUT_OK;
             }
             if (strcmp(reply, "FAIL") == 0) return SUT_FAIL;
-            return SUT_UNKNOWN;
+            /* UNKNOWN reply: delivered, outcome unresolved (durable
+             * wait timed out / leaderless window) — safe to retry,
+             * the nonce dedups */
+            maybe_delivered = true;
+        } else if (rc == -2) {
+            maybe_delivered = true;     /* sent, no complete reply */
         }
-        if (rc == -2) return SUT_UNKNOWN;
-        next_node(t);               /* clean failure: retry elsewhere */
+        next_node(t);
+        if (rc != -1 && attempt + 1 < t->max_retries)
+            /* give a fault window time to move (skip after the
+             * final attempt — the sleep would be dead latency) */
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(100));
     }
-    return SUT_FAIL;                /* never delivered anywhere */
+    return maybe_delivered ? SUT_UNKNOWN : SUT_FAIL;
 }
 
 /* read: retry elsewhere freely, but only accept an answer from a node
@@ -143,6 +166,7 @@ sut_tcp *sut_tcp_open(const char *target, unsigned seed) {
         return nullptr;
     }
     t->cur = t->rng() % t->hosts.size();   /* CDB2_RANDOM routing */
+    t->session = ((unsigned long long)t->rng() << 8) ^ t->rng();
     return t;
 }
 

@@ -129,19 +129,22 @@ def _keyed(f, seed):
     return op
 
 
-def _nemesis_gen(seed, secs=4.0, window=1.0, lead=0.3, gap=0.6):
+def _nemesis_gen(seed, secs=4.0, window=1.0, lead=0.3, gap=0.6,
+                 cycles=2, mix=None):
     """Clients run for the whole span (time-limited, not op-limited: an
     op-count budget can drain before the first partition opens) while
-    the nemesis cycles two partition windows of ``window`` seconds."""
+    the nemesis cycles ``cycles`` partition windows of ``window``
+    seconds."""
     kr, kw, kc = (_keyed("read", seed), _keyed("write", seed),
                   _keyed("cas", seed))
+    steps = [G.sleep(lead)]
+    for _ in range(cycles):
+        steps += [{"type": "info", "f": "start"}, G.sleep(window),
+                  {"type": "info", "f": "stop"}, G.sleep(gap)]
     return G.nemesis(
-        G.seq([G.sleep(lead), {"type": "info", "f": "start"},
-               G.sleep(window), {"type": "info", "f": "stop"},
-               G.sleep(gap), {"type": "info", "f": "start"},
-               G.sleep(window), {"type": "info", "f": "stop"}]),
+        G.seq(steps),
         G.time_limit(secs, G.stagger(
-            0.01, G.mix([kr, kr, kw, kc]))))
+            0.01, G.mix(mix or [kr, kr, kw, kc]))))
 
 
 def test_durable_cluster_valid_under_partition(tmp_path):
@@ -335,6 +338,8 @@ def test_replication_protocol_certifies_before_counting():
 
     import subprocess
 
+    from comdb2_tpu.workloads.tcp import _wait_ready
+
     ports = _free_ports(3)
     # only node 1 is real (peers 0/2 never answer); elect_ms is huge so
     # it never campaigns and our scripted leaders fully own its state
@@ -342,19 +347,14 @@ def test_replication_protocol_certifies_before_counting():
         [BINARY, "-i", "1", "-n", ",".join(map(str, ports)),
          "-t", "300", "-e", "60000", "-l", "300"],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_ready(proc, ports[1], time.monotonic() + 5.0, "sut_node")
+    except RuntimeError:
+        proc.kill()
+        proc.wait()
+        raise
     conn = SutConnection("127.0.0.1", ports[1], timeout_s=1.0)
-    deadline = time.monotonic() + 5.0
-    while True:
-        try:
-            conn.connect()
-            if conn.request("P") == "PONG":
-                break
-        except (OSError, TimeoutError):
-            if time.monotonic() > deadline:
-                proc.kill()
-                proc.wait()
-                raise
-            time.sleep(0.05)
+    conn.connect()
     try:
         # leader 0, term 5: heartbeat certifies nothing yet
         assert conn.request("H 0 5 0") == "A 0"
@@ -383,3 +383,186 @@ def test_replication_protocol_certifies_before_counting():
         conn.close()
         proc.kill()
         proc.wait()
+
+
+def test_dedup_replays_recorded_outcome():
+    """Protocol pin of the blkseq role: a nonce-wrapped mutation that
+    already applied returns its RECORDED outcome on retry — the cas
+    does not re-execute (which would FAIL its precondition the second
+    time), and the register shows exactly one application."""
+    from comdb2_tpu.workloads.tcp import SutConnection
+
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800)
+    conn = SutConnection("127.0.0.1", ports[0], timeout_s=2.0)
+    try:
+        conn.connect()
+        r1 = conn.request("M 901 W 1 5")
+        assert r1.startswith("OK")
+        # replay of the applied write: same recorded lsn
+        assert conn.request("M 901 W 1 5") == r1
+        r2 = conn.request("M 902 C 1 5 6")
+        assert r2.startswith("OK")
+        # the replayed cas must NOT re-execute (regs is now 6 != 5,
+        # re-execution would FAIL); dedup returns the recorded OK
+        assert conn.request("M 902 C 1 5 6") == r2
+        assert conn.request("R 1") == "V 6"
+        # a FAILed cas is never logged: its retry re-executes fresh
+        assert conn.request("M 903 C 1 99 7") == "FAIL"
+        assert conn.request("M 903 C 1 6 7").startswith("OK")
+        assert conn.request("R 1") == "V 7"
+    finally:
+        conn.close()
+        _kill(procs)
+
+
+def test_no_dedup_retried_cas_double_applies():
+    """The -D negative control at the protocol level: without the
+    dedup table a replayed cas re-executes — the retry FAILs its
+    precondition even though the first attempt applied, the
+    fail-but-applied outcome the checker must treat as an anomaly."""
+    from comdb2_tpu.workloads.tcp import SutConnection
+
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800,
+                          flags=["-D"])
+    conn = SutConnection("127.0.0.1", ports[0], timeout_s=2.0)
+    try:
+        conn.connect()
+        assert conn.request("M 901 W 1 5").startswith("OK")
+        assert conn.request("M 902 C 1 5 6").startswith("OK")
+        # the "retry": re-executes and fails — but the first DID apply
+        assert conn.request("M 902 C 1 5 6") == "FAIL"
+        assert conn.request("R 1") == "V 6"
+    finally:
+        conn.close()
+        _kill(procs)
+
+
+def test_ha_driver_few_infos_under_partitions(tmp_path):
+    """VERDICT #4's done-criterion: ct_register over a partitioned
+    cluster produces MOSTLY ok/fail (the nonce retry resolves fault-
+    window ops) and the history stays linearizable. Before dedup every
+    possibly-delivered op was an instant info and fault histories
+    drowned in forever-pending ops."""
+    import subprocess
+    import threading
+
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.history import parse_history
+
+    ports = _free_ports(3)
+    nodes = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=400,
+                          elect_ms=500, lease_ms=300)
+    ctl = ClusterControl(ports)
+    stop = threading.Event()
+
+    def nemesis():
+        while not stop.wait(0.8):
+            pri = ctl.primary()
+            if pri is None:
+                continue
+            ctl.partition([pri], [i for i in range(3) if i != pri])
+            if stop.wait(1.2):
+                break
+            ctl.heal()
+
+    th = threading.Thread(target=nemesis)
+    th.start()
+    out = tmp_path / "ha_dedup.edn"
+    try:
+        p = subprocess.run(
+            [os.path.join(ROOT, "native", "build", "ct_register"),
+             "-T", "4", "-r", "8", "-d", nodes, "-j", str(out),
+             "-s", "77"],
+            capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stderr
+    finally:
+        stop.set()
+        th.join()
+        ctl.heal()
+        _kill(procs)
+
+    h = parse_history(out.read_text())
+    counts = {}
+    for op in h:
+        counts[op.type] = counts.get(op.type, 0) + 1
+    n_ops = counts.get("invoke", 0)
+    n_info = counts.get("info", 0)
+    assert n_ops >= 200, counts
+    # "mostly ok/fail, few info": the retry budget resolves all but
+    # the ops still in flight when a window outlives the budget
+    assert n_info <= max(10, n_ops // 20), counts
+    a = analysis(cas_register(), h, backend="host")
+    assert a.valid is True, "seed 77 HA history must be linearizable"
+
+
+def test_no_dedup_cluster_detected_invalid():
+    """The -D control, end to end and DETERMINISTIC: drive the exact
+    dangerous interleaving over the wire — first attempt delivered to
+    the leader during a partition blip (durable wait times out
+    UNKNOWN), entry commits after heal, retry re-executes and FAILs
+    its precondition — then check the client-visible history. With
+    dedup the same interleaving replays the recorded OK and the
+    history is linearizable; without it the cas is recorded ``fail``
+    though it applied, and the committed read of its value has no
+    explanation: the checker must flag INVALID."""
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.op import Op
+    from comdb2_tpu.workloads.tcp import SutConnection
+
+    def run_once(no_dedup):
+        ports = _free_ports(3)
+        flags = ["-D"] if no_dedup else []
+        procs = spawn_cluster(BINARY, ports, durable=True,
+                              timeout_ms=300, elect_ms=3000,
+                              lease_ms=300, flags=flags)
+        ctl = ClusterControl(ports)
+        conn = SutConnection("127.0.0.1", ports[0], timeout_s=2.0)
+        try:
+            conn.connect()
+            assert conn.request("W 1 5").startswith("OK")
+            # blip: leader cut from both replicas, shorter than any
+            # election timeout — leadership never moves
+            ctl.partition([0], [1, 2])
+            r1 = conn.request("M 77 C 1 5 6")
+            assert r1 == "UNKNOWN", r1   # delivered, durable wait out
+            ctl.heal()
+            assert ctl.await_replicated(timeout_s=8.0)
+            r2 = conn.request("M 77 C 1 5 6")    # the HA retry
+            r3 = conn.request("R 1")
+            return r2, r3
+        finally:
+            conn.close()
+            ctl.heal()
+            _kill(procs)
+
+    def verdict(cas_outcome, read_reply):
+        # the client-visible history: write ok, one cas with the
+        # retry's final outcome, one committed read
+        val = (None if read_reply == "NIL"
+               else int(read_reply.split()[1]))
+        h = [Op(process=0, type="invoke", f="write", value=5, time=0),
+             Op(process=0, type="ok", f="write", value=5, time=1),
+             Op(process=1, type="invoke", f="cas", value=(5, 6), time=2),
+             Op(process=1, type=cas_outcome, f="cas", value=(5, 6),
+                time=3),
+             Op(process=2, type="invoke", f="read", value=None, time=4),
+             Op(process=2, type="ok", f="read", value=val, time=5)]
+        return analysis(cas_register(), h, backend="host").valid
+
+    # dedup ON: the retry replays the recorded OK — linearizable
+    r2, r3 = run_once(no_dedup=False)
+    assert r2.startswith("OK"), r2
+    assert r3 == "V 6", r3
+    assert verdict("ok", r3) is True
+
+    # dedup OFF: the retry re-executes and FAILs though the first
+    # attempt committed — the history must be INVALID
+    r2, r3 = run_once(no_dedup=True)
+    assert r2 == "FAIL", r2
+    assert r3 == "V 6", r3
+    assert verdict("fail", r3) is False
