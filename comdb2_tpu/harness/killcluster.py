@@ -114,3 +114,109 @@ def run(test: dict,
         out["valid?"] = "unknown"
         out["error"] = repr(errors[0])
     return out
+
+
+def cluster_kill_restart(procs, rounds: int = 2, pause_s: float = 0.3,
+                         between_s: float = 1.5) -> Callable[[], None]:
+    """Disruptor for the in-tree replicated SUT: kill -9 EVERY
+    ``sut_node`` (no shutdown path — un-fsynced state dies), restart
+    them from their state dirs, repeat. The killclustertest.sh:36-84
+    shape against a :class:`~comdb2_tpu.workloads.tcp.ClusterProcs`."""
+    def disrupt():
+        for _ in range(rounds):
+            procs.kill9_all()
+            time.sleep(pause_s)
+            procs.restart_all()
+            time.sleep(between_s)
+    return disrupt
+
+
+def cluster_oracle(n_values: int) -> Iterable[str]:
+    """Expected transcript of :func:`cluster_set_workload`: every add
+    acknowledged exactly once, every acknowledged value present in the
+    final committed read."""
+    yield "[begin] rc 0"
+    for i in range(n_values):
+        yield f"[add {i}] rc 0"
+    for i in range(n_values):
+        yield f"(v={i})"
+    yield "[commit] rc 0"
+
+
+def cluster_set_workload(ports, n_values: int,
+                         timeout_s: float = 0.5,
+                         per_value_deadline_s: float = 20.0,
+                         pace_s: float = 0.0):
+    """Deterministic-transcript workload against a sut_node cluster:
+    add values 0..n-1 through replay-nonce retries (each value is
+    retried until one OK — exactly-once by dedup), then read the
+    committed set back from the primary. A crash-restart in flight
+    only delays an add; an add acked BEFORE a crash must still be in
+    the final read — that is the durability contract under test."""
+    import random as _random
+
+    from ..workloads.tcp import ClusterControl, SutConnection
+
+    session = _random.SystemRandom().getrandbits(32)
+
+    def one_request(port, line):
+        conn = SutConnection("127.0.0.1", port, timeout_s)
+        try:
+            conn.connect()
+            return conn.request(line)
+        finally:
+            conn.close()
+
+    def workload():
+        yield "[begin] rc 0"
+        ix = 0
+        for i in range(n_values):
+            nonce = (session << 24) | (i + 1)
+            deadline = time.monotonic() + per_value_deadline_s
+            rc = "?"
+            while time.monotonic() < deadline:
+                port = ports[ix % len(ports)]
+                ix += 1
+                try:
+                    r = one_request(port, f"M {nonce} A {i}")
+                except (TimeoutError, OSError):
+                    time.sleep(0.05)
+                    continue
+                if r.startswith("OK"):
+                    rc = "0"
+                    break
+                time.sleep(0.05)
+            yield f"[add {i}] rc {rc}"
+            if pace_s:
+                # pace the stream so a disruptor's kill-restart lands
+                # MID-RUN (a full-speed burst would finish before the
+                # first kill and the test would exercise nothing)
+                time.sleep(pace_s)
+        # final committed read: wait for the cluster to settle, then
+        # read the set from the current primary
+        ctl = ClusterControl(ports, timeout_s=2.0)
+        ctl.await_replicated(timeout_s=10.0)
+        vals = []
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            pri = ctl.primary()
+            if pri is None:
+                time.sleep(0.1)
+                continue
+            try:
+                r = one_request(ports[pri], "S")
+            except (TimeoutError, OSError):
+                time.sleep(0.1)
+                continue
+            if r.startswith("V"):
+                vals = [int(x) for x in r[1:].split()]
+                break
+            time.sleep(0.1)
+        # raw (not deduplicated): a double-applied add — the exact
+        # anomaly the replay nonces exist to prevent — must show up
+        # as a duplicate line and diff against the oracle
+        for v in sorted(vals):
+            yield f"(v={v})"
+        yield "[commit] rc 0"
+
+    return workload

@@ -344,19 +344,71 @@ class ClusterPartitioner:
         return dict(op)
 
 
+class ClusterProcs(list):
+    """The ``sut_node`` processes of one cluster, with enough spawn
+    context to KILL -9 and RESTART members mid-run — the killcluster
+    disruptor's handle (``killclustertest.sh:36-84`` kill-9s real DB
+    processes and relies on txn-log recovery). Subclasses list so
+    existing ``for p in procs: p.kill()`` teardowns keep working."""
+
+    def __init__(self, procs, argv_per_node, ports, wait_s=5.0):
+        super().__init__(procs)
+        self.argv_per_node = argv_per_node
+        self.ports = list(ports)
+        self.wait_s = wait_s
+
+    def kill9(self, i: int) -> None:
+        """SIGKILL node ``i`` (no shutdown path runs — buffered,
+        un-fsynced state dies with the process)."""
+        self[i].kill()
+        self[i].wait()
+
+    def restart(self, i: int, wait_ready: bool = True) -> None:
+        """Restart node ``i`` with its original argv (same state dir:
+        recovery replays the log)."""
+        import subprocess
+        import time
+
+        self[i] = subprocess.Popen(self.argv_per_node[i],
+                                   stdout=subprocess.DEVNULL,
+                                   stderr=subprocess.DEVNULL)
+        if wait_ready:
+            _wait_ready(self[i], self.ports[i],
+                        time.monotonic() + self.wait_s, "sut_node")
+
+    def kill9_all(self) -> None:
+        for i in range(len(self)):
+            self[i].kill()
+        for i in range(len(self)):
+            self[i].wait()
+
+    def restart_all(self) -> None:
+        for i in range(len(self)):
+            self.restart(i, wait_ready=False)
+        import time
+
+        deadline = time.monotonic() + self.wait_s
+        for i, port in enumerate(self.ports):
+            _wait_ready(self[i], port, deadline, "sut_node")
+
+
 def spawn_cluster(binary: str, ports, durable: bool = True,
                   timeout_ms: int = 2000, wait_s: float = 5.0,
                   elect_ms: Optional[int] = None,
                   lease_ms: Optional[int] = None,
-                  flags: Sequence[str] = ()):
-    """Start one ``sut_node`` per port on localhost; returns the list
-    of processes once every node answers PING. ``elect_ms``/``lease_ms``
-    tune the failover timings; ``flags`` passes extra per-node options
-    (e.g. ``["-B"]`` for the split-brain control)."""
+                  dirs: Optional[Sequence[str]] = None,
+                  flags: Sequence[str] = ()) -> "ClusterProcs":
+    """Start one ``sut_node`` per port on localhost; returns a
+    :class:`ClusterProcs` once every node answers PING.
+    ``elect_ms``/``lease_ms`` tune the failover timings; ``dirs`` gives
+    each node a persistent state directory (crash-restart recovery);
+    ``flags`` passes extra per-node options (e.g. ``["-B"]`` for the
+    split-brain control, ``["-x"]`` for no-fsync)."""
     import subprocess
     import time
 
     plist = ",".join(str(p) for p in ports)
+    argv_per_node = []
     procs = []
     for i in range(len(ports)):
         args = [binary, "-i", str(i), "-n", plist,
@@ -365,26 +417,24 @@ def spawn_cluster(binary: str, ports, durable: bool = True,
             args += ["-e", str(elect_ms)]
         if lease_ms is not None:
             args += ["-l", str(lease_ms)]
+        if dirs is not None:
+            args += ["-d", str(dirs[i])]
         if not durable:
             args.append("-N")
         args += list(flags)
+        argv_per_node.append(args)
         procs.append(subprocess.Popen(args,
                                       stdout=subprocess.DEVNULL,
                                       stderr=subprocess.DEVNULL))
-    def kill_all():
-        for p in procs:
-            p.kill()
-        for p in procs:
-            p.wait()
-
+    cluster = ClusterProcs(procs, argv_per_node, ports, wait_s=wait_s)
     deadline = time.monotonic() + wait_s
     try:
         for i, port in enumerate(ports):
             _wait_ready(procs[i], port, deadline, "sut_node")
     except RuntimeError:
-        kill_all()
+        cluster.kill9_all()
         raise
-    return procs
+    return cluster
 
 
 def _wait_ready(proc, port: int, deadline: float, name: str) -> None:

@@ -23,12 +23,22 @@
  * Divergent uncommitted suffixes on a rejoining old primary are
  * truncated by the log-matching check in the replication stream.
  *
+ * Persistence (-d dir): an fsync'd append-only log + term/vote meta
+ * (the berkdb txn-log role). Every entry hits disk before it is acked
+ * upstream or counted toward durability, so kill -9 of an acked
+ * write's entire cohort never loses the write; recovery replays the
+ * log and the node rejoins as a replica (its pre-crash leadership is
+ * stale until an election says otherwise).
+ *
  * Negative controls:
  *   --no-durable (-N): writes acked after local apply only — a
  *     partition yields real stale reads / lost writes.
  *   --split-brain (-B): a leader that loses quorum neither demotes nor
  *     waits for majority acks — two primaries accept writes and their
  *     registers diverge; the checker must flag the history INVALID.
+ *   --no-fsync (-x): log writes sit in a userspace buffer — kill -9
+ *     loses the acked tail and the set/linearizable checkers must
+ *     catch the loss.
  *
  * Topology: all nodes on 127.0.0.1, one port each; node 0 is the
  * initial leader (term 1) so fault-free startup needs no election.
@@ -64,6 +74,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -104,6 +115,13 @@ struct Node {
     bool durable = true;
     bool split_brain = false;   /* negative control: never demote */
     bool no_dedup = false;      /* negative control: replay re-executes */
+    bool no_fsync = false;      /* negative control: acked writes live
+                                 * in a userspace buffer only — kill -9
+                                 * loses the tail (with fsync on, every
+                                 * entry is on disk before it is acked
+                                 * or counted toward durability) */
+    std::string dir;            /* state directory; empty = in-memory */
+    FILE *log_fp = nullptr;
     int timeout_ms = 2000;      /* durable-LSN wait (lrl:17 = 2000ms) */
     int hb_ms = 40;             /* heartbeat cadence */
     int lease_ms = 350;         /* quorum-contact freshness for serving */
@@ -186,6 +204,62 @@ struct Node {
     }
 
     /* caller holds mu */
+    /* persistence (the berkdb txn-log role,
+     * killclustertest.sh:36-84's recovery contract): one line per log
+     * entry, appended and fsync'd BEFORE the entry is acked upstream
+     * or counted toward durability — so a majority-acked write
+     * survives kill -9 of its whole cohort. Truncations rewrite the
+     * file (rare: only divergent-suffix repair). */
+    void persist_append_locked(const LogEntry &e) {
+        if (log_fp == nullptr) return;
+        fprintf(log_fp, "%lld %c %lld %lld %lld %llu\n", e.term, e.kind,
+                e.key, e.a, e.b, e.nonce);
+        if (!no_fsync) {
+            fflush(log_fp);
+            fsync(fileno(log_fp));
+        }
+    }
+
+    void persist_rewrite_locked() {
+        if (log_fp == nullptr) return;
+        /* write-tmp-then-rename (like the meta file): an in-place
+         * "w" truncation would zero the fsync'd log for the duration
+         * of the rewrite, and a kill -9 in that window would lose
+         * COMMITTED entries — exactly the contract this file exists
+         * to keep */
+        std::string tmp = dir + "/log.tmp", path = dir + "/log";
+        FILE *f = fopen(tmp.c_str(), "w");
+        if (f == nullptr) abort();
+        for (const LogEntry &e : log)
+            fprintf(f, "%lld %c %lld %lld %lld %llu\n", e.term,
+                    e.kind, e.key, e.a, e.b, e.nonce);
+        if (!no_fsync) {
+            fflush(f);
+            fsync(fileno(f));
+        }
+        fclose(f);
+        if (rename(tmp.c_str(), path.c_str()) != 0) abort();
+        fclose(log_fp);
+        log_fp = fopen(path.c_str(), "a");
+        if (log_fp == nullptr) abort();
+        if (no_fsync)
+            setvbuf(log_fp, nullptr, _IOFBF, 1 << 20);
+    }
+
+    void persist_meta_locked() {
+        if (dir.empty()) return;
+        std::string tmp = dir + "/meta.tmp", path = dir + "/meta";
+        FILE *f = fopen(tmp.c_str(), "w");
+        if (f == nullptr) return;
+        fprintf(f, "%lld %d\n", term, voted_for);
+        if (!no_fsync) {
+            fflush(f);
+            fsync(fileno(f));
+        }
+        fclose(f);
+        rename(tmp.c_str(), path.c_str());
+    }
+
     void apply_locked(const LogEntry &e) {
         if (e.kind == 'W') {
             regs[e.key] = e.a;
@@ -225,6 +299,13 @@ struct Node {
     void append_locked(const LogEntry &e) {
         log.push_back(e);
         apply_locked(e);
+        persist_append_locked(e);
+    }
+
+    /* recovery replay: apply without re-writing the file */
+    void append_recovered_locked(const LogEntry &e) {
+        log.push_back(e);
+        apply_locked(e);
     }
 
     /* drop log entries past lsn and rebuild applied state by replay —
@@ -240,7 +321,8 @@ struct Node {
         applied_lsn = 0;
         std::vector<LogEntry> entries;
         entries.swap(log);
-        for (const LogEntry &e : entries) append_locked(e);
+        for (const LogEntry &e : entries) append_recovered_locked(e);
+        persist_rewrite_locked();
         if (certified_lsn > (long long)log.size())
             certified_lsn = (long long)log.size();
     }
@@ -282,6 +364,8 @@ struct Node {
         if (new_term > term) {
             term = new_term;
             voted_for = -1;
+            persist_meta_locked();      /* a vote in the old term must
+                                         * not resurrect after restart */
         }
         if (role != REPLICA) {
             role = REPLICA;
@@ -456,6 +540,7 @@ void election_thread() {
             /* campaign */
             n.term++;
             n.voted_for = n.id;
+            n.persist_meta_locked();
             n.role = CANDIDATE;
             n.leader = -1;
             n.last_leader_contact = now;    /* back off before retry */
@@ -696,6 +781,8 @@ std::string handle(const std::string &line, bool forwarded) {
                      up_to_date;
         if (grant) {
             n.voted_for = from;
+            n.persist_meta_locked();    /* one vote per term, even
+                                         * across a crash-restart */
             n.last_leader_contact = mono_ms();  /* don't also campaign */
         }
         return "G " + std::to_string(n.term) + (grant ? " 1" : " 0");
@@ -886,7 +973,7 @@ int main(int argc, char **argv) {
     std::string peers;
     int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:e:l:NBDh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xNBDh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
@@ -897,11 +984,14 @@ int main(int argc, char **argv) {
         case 'N': n.durable = false; break;
         case 'B': n.split_brain = true; break;
         case 'D': n.no_dedup = true; break;
+        case 'd': n.dir = optarg; break;
+        case 'x': n.no_fsync = true; break;
         default:
             fprintf(stderr,
                     "usage: %s -i id -n port0,port1,... [-P leader0] "
                     "[-t durable_timeout_ms] [-e elect_base_ms] "
-                    "[-l lease_ms] [-N (no-durable)] "
+                    "[-l lease_ms] [-d state_dir] "
+                    "[-x (no-fsync control)] [-N (no-durable)] "
                     "[-B (split-brain control)] "
                     "[-D (no-dedup control)]\n",
                     argv[0]);
@@ -928,8 +1018,54 @@ int main(int argc, char **argv) {
     }
     n.acked_upto.assign(n.ports.size(), 0);
     n.last_ack.assign(n.ports.size(), mono_ms());
-    n.leader = initial_leader;
-    n.role = n.id == initial_leader ? PRIMARY : REPLICA;
+
+    bool recovered = false;
+    if (!n.dir.empty()) {
+        mkdir(n.dir.c_str(), 0755);
+        std::string meta_path = n.dir + "/meta";
+        if (FILE *f = fopen(meta_path.c_str(), "r")) {
+            long long t = 0;
+            int v = -1;
+            if (fscanf(f, "%lld %d", &t, &v) == 2 && t >= 1) {
+                n.term = t;
+                n.voted_for = v;
+                recovered = true;
+            }
+            fclose(f);
+        }
+        std::string log_path = n.dir + "/log";
+        if (FILE *f = fopen(log_path.c_str(), "r")) {
+            LogEntry e;
+            while (fscanf(f, "%lld %c %lld %lld %lld %llu", &e.term,
+                          &e.kind, &e.key, &e.a, &e.b, &e.nonce) == 6)
+                n.append_recovered_locked(e);
+            fclose(f);
+            if (!n.log.empty()) recovered = true;
+        }
+        n.log_fp = fopen(log_path.c_str(), "a");
+        if (n.log_fp == nullptr) {
+            perror("open log");
+            return 2;
+        }
+        if (n.no_fsync)     /* big buffer, never flushed: the tail
+                             * dies with the process — the control */
+            setvbuf(n.log_fp, nullptr, _IOFBF, 1 << 20);
+    }
+    /* An in-memory fresh cluster boots with a static initial leader
+     * (no election needed). A PERSISTENT node always boots as a
+     * replica — even with an empty dir: it cannot distinguish "fresh
+     * cluster" from "my state was wiped while the cluster progressed",
+     * and self-appointing as term-1 primary into a progressed cluster
+     * would serve committed-empty stale reads until the real leader's
+     * heartbeat demotes it. The first election sorts out who leads
+     * (vote gating keeps it safe). */
+    if (recovered || !n.dir.empty()) {
+        n.leader = -1;
+        n.role = REPLICA;
+    } else {
+        n.leader = initial_leader;
+        n.role = n.id == initial_leader ? PRIMARY : REPLICA;
+    }
     n.last_leader_contact = mono_ms();
     signal(SIGPIPE, SIG_IGN);
 
