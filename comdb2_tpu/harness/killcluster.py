@@ -199,12 +199,21 @@ def cluster_set_workload(ports, n_values: int,
         vals = []
         deadline = time.monotonic() + 10.0
         while time.monotonic() < deadline:
-            pri = ctl.primary()
-            if pri is None:
+            # the primary's COMMITTED prefix must have caught up to
+            # its applied log before the read counts (a freshly
+            # elected post-restart primary commits the recovered tail
+            # heartbeat-paced) — otherwise a correct cluster could
+            # flakily read short and diff as a false loss
+            pri_info = next((i for i in ctl.info()
+                             if i["role"] == "primary"
+                             and i.get("durable") == i.get("applied")),
+                            None)
+            if pri_info is None:
                 time.sleep(0.1)
                 continue
             try:
-                r = one_request(ports[pri], "S")
+                r = one_request(ports[ctl.ports.index(
+                    pri_info["port"])], "S")
             except (TimeoutError, OSError):
                 time.sleep(0.1)
                 continue

@@ -475,3 +475,231 @@ def spawn_server(binary: str, port: int, *flags: str,
         proc.wait()
         raise
     return proc
+
+
+class ClusterTxn:
+    """One wire transaction against a ``sut_node`` cluster — the
+    client side of the TB/TR/TP/TW/TI/TC verbs (the cdb2 begin/.../
+    commit surface; server-side OCC validation at commit). All verbs
+    forward to the leader, so the txn can be driven through any node."""
+
+    def __init__(self, conn: SutConnection):
+        self.conn = conn
+        self.txid: Optional[int] = None
+
+    def begin(self) -> None:
+        reply = self.conn.request("TB")
+        if not reply.startswith("T "):
+            raise TxnAborted(f"begin failed: {reply}")
+        self.txid = int(reply[2:])
+
+    def read(self, key: int) -> Optional[int]:
+        reply = self.conn.request(f"TR {self.txid} {key}")
+        if reply == "NIL":
+            return None
+        if reply.startswith("V "):
+            return int(reply[2:])
+        raise TxnAborted(f"read failed: {reply}")
+
+    def predicate(self, table: str, key: int):
+        """All committed rows of (table, key) as [(id, value)]."""
+        reply = self.conn.request(f"TP {self.txid} {table} {key}")
+        if not reply.startswith("V"):
+            raise TxnAborted(f"predicate failed: {reply}")
+        rows = []
+        for tok in reply[1:].split():
+            rid, val = tok.split(":")
+            rows.append((int(rid), int(val)))
+        return rows
+
+    def write(self, key: int, val: int) -> None:
+        reply = self.conn.request(f"TW {self.txid} {key} {val}")
+        if reply != "OK":
+            raise TxnAborted(f"write failed: {reply}")
+
+    def insert(self, table: str, key: int, rid: int, val: int) -> None:
+        reply = self.conn.request(
+            f"TI {self.txid} {table} {key} {rid} {val}")
+        if reply != "OK":
+            raise TxnAborted(f"insert failed: {reply}")
+
+    def commit(self, nonce: int = 0) -> str:
+        """Returns "ok" | "fail" | "unknown"."""
+        line = (f"TC {self.txid} {nonce}" if nonce
+                else f"TC {self.txid}")
+        reply = self.conn.request(line)
+        if reply.startswith("OK"):
+            return "ok"
+        if reply == "FAIL":
+            return "fail"
+        return "unknown"
+
+    def abort(self) -> None:
+        try:
+            self.conn.request(f"TA {self.txid}")
+        except (TimeoutError, OSError):
+            pass
+
+
+class TxnAborted(Exception):
+    """A txn verb failed server-side (conflict / failover): the txn is
+    dead and nothing was applied — a clean :fail for mutations."""
+
+
+class _ClusterTxnClientBase(client_ns.Client):
+    """Shared plumbing for txn workload clients: per-worker node
+    assignment (cycled), a txn runner that maps conflicts to ``fail``
+    and lost outcomes to ``info``."""
+
+    def __init__(self, ports, timeout_s: float = 1.0):
+        self.ports = list(ports)
+        self.timeout_s = timeout_s
+        self._next = 0
+        self.conn: Optional[SutConnection] = None
+        self._session = 0
+        self._seq = 0
+
+    def _clone(self):
+        raise NotImplementedError
+
+    def setup(self, test, node):
+        import random as _random
+
+        c = self._clone()
+        port = self.ports[self._next % len(self.ports)]
+        self._next += 1
+        c.conn = SutConnection("127.0.0.1", port, self.timeout_s)
+        c.conn.connect()
+        c._session = _random.SystemRandom().getrandbits(32)
+        return c
+
+    def teardown(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def _nonce(self) -> int:
+        self._seq += 1
+        return (self._session << 24) | self._seq
+
+    def _run_txn(self, op, body, read_only=False):
+        """Run ``body(txn)`` in one wire txn; body returns the ``ok``
+        completion (or a full completion dict to use verbatim)."""
+        txn = ClusterTxn(self.conn)
+        try:
+            txn.begin()
+            out = body(txn)
+            if isinstance(out, dict) and out.get("type") != "ok":
+                txn.abort()
+                return out
+            verdict = txn.commit(0 if read_only else self._nonce())
+            if verdict == "ok":
+                if isinstance(out, dict):
+                    return out
+                if out is None:
+                    # keep the INVOKED value (e.g. G2's (key, ids) —
+                    # the checker keys on it); body returns a value
+                    # only when the completion carries new data
+                    return {**op, "type": "ok"}
+                return {**op, "type": "ok", "value": out}
+            if verdict == "fail":
+                return {**op, "type": "fail"}
+            return {**op, "type": ("fail" if read_only else "info"),
+                    "error": "commit unknown"}
+        except TxnAborted as e:
+            return {**op, "type": "fail", "error": str(e)}
+        except (TimeoutError, OSError) as e:
+            # a lost reply mid-txn: reads are side-effect-free (fail);
+            # a lost COMMIT reply is indeterminate (info)
+            return {**op, "type": ("fail" if read_only else "info"),
+                    "error": str(e)}
+
+
+class BankTcpClient(_ClusterTxnClientBase):
+    """The bank workload over the wire (``comdb2/core.clj:71-129``):
+    accounts are registers keyed 0..n-1; transfers read both balances
+    and write both back in one OCC txn — serializability of the commit
+    validation is what keeps the total balance invariant."""
+
+    def __init__(self, ports, n: int, starting_balance: int = 10,
+                 timeout_s: float = 1.0):
+        super().__init__(ports, timeout_s)
+        self.n = n
+        self.starting_balance = starting_balance
+
+    def _clone(self):
+        return BankTcpClient(self.ports, self.n, self.starting_balance,
+                             self.timeout_s)
+
+    def setup(self, test, node):
+        c = super().setup(test, node)
+        deadline = __import__("time").monotonic() + 15.0
+        while __import__("time").monotonic() < deadline:
+            txn = ClusterTxn(c.conn)
+            try:
+                txn.begin()
+                missing = [i for i in range(c.n)
+                           if txn.read(i) is None]
+                for i in missing:
+                    txn.write(i, c.starting_balance)
+                if txn.commit(c._nonce()) == "ok" or not missing:
+                    return c
+            except (TxnAborted, TimeoutError, OSError):
+                pass
+            __import__("time").sleep(0.1)
+        raise RuntimeError("could not initialize bank accounts")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            def body(txn):
+                balances = []
+                for i in range(self.n):
+                    v = txn.read(i)
+                    if v is None:
+                        raise TxnAborted("uninitialized account")
+                    balances.append(v)
+                return tuple(balances)
+            return self._run_txn(op, body, read_only=True)
+        if op["f"] == "transfer":
+            v = op["value"]
+            frm, to, amount = v["from"], v["to"], v["amount"]
+
+            def body(txn):
+                b1 = txn.read(frm)
+                b2 = txn.read(to)
+                if b1 is None or b2 is None:
+                    raise TxnAborted("uninitialized account")
+                if b1 - amount < 0:
+                    return {**op, "type": "fail",
+                            "value": ("negative", frm, b1 - amount)}
+                txn.write(frm, b1 - amount)
+                txn.write(to, b2 + amount)
+                return None
+            return self._run_txn(op, body)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+
+class G2TcpClient(_ClusterTxnClientBase):
+    """Adya G2 over the wire (``jepsen/adya.clj:12-55``): predicate-
+    read tables a and b for the key; if neither holds a matching row,
+    insert this op's id into its table. Phantom safety comes from the
+    server's per-(table, key) version validation at commit: at most
+    one insert can commit per key."""
+
+    def _clone(self):
+        return G2TcpClient(self.ports, self.timeout_s)
+
+    def invoke(self, test, op):
+        k, ids = op["value"]
+        a_id, b_id = ids
+
+        def body(txn):
+            a = [r for r in txn.predicate("a", k) if r[1] % 3 == 0]
+            b = [r for r in txn.predicate("b", k) if r[1] % 3 == 0]
+            if a or b:
+                return {**op, "type": "fail"}
+            if a_id is not None:
+                txn.insert("a", k, a_id, 30)
+            else:
+                txn.insert("b", k, b_id, 30)
+            return None
+        return self._run_txn(op, body)

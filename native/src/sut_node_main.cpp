@@ -56,6 +56,14 @@
  *                 the negative control where a retried cas re-executes
  *                 and double-applies
  *   S          -> "V <v1> ..."                    set read (local)
+ *   TB / TR / TP / TW / TI / TC / TA              transactions over
+ *                 the wire: begin, committed read, predicate read,
+ *                 buffered write/insert, OCC-validated commit, abort
+ *                 (grammar at the handler; the comdb2 osql shape —
+ *                 reads record versions, commit validates and applies
+ *                 atomically at the leader, db/toblock.c:1953's role).
+ *                 --buggy-txn (-T) commits WITHOUT validation — the
+ *                 lost-update / G2 negative control
  *   P          -> "PONG"
  *   I          -> "I <id> <role> <applied> <durable> <term> <leader>"
  *   B <peer>   -> "OK"   drop traffic with node <peer>  (partition)
@@ -101,11 +109,54 @@ long long mono_ms() {
         .count();
 }
 
+/* one write inside a transaction: 'W' reg k=a; 'I' insert (id=a,
+ * val=b) into table t (0='a', 1='b') under key k */
+struct SubOp {
+    char kind = 'W';
+    long long t = 0, k = 0, a = 0, b = 0;
+};
+
 struct LogEntry {
     long long term = 0;
-    char kind = 'N';        /* 'W', 'C', 'A', 'N' (no-op) */
+    char kind = 'N';        /* 'W','C','A','N'(no-op),'T'(txn) */
     long long key = 0, a = 0, b = 0;
     unsigned long long nonce = 0;   /* client replay nonce; 0 = none */
+    std::vector<SubOp> ops;         /* kind 'T' only */
+};
+
+/* the replicated state machine — two instances per node: SPECULATIVE
+ * (whole log applied; feeds cas/txn-validation, which is safe because
+ * log order = serial order) and COMMITTED (durable prefix only; feeds
+ * reads). Versions are the lsn of the last write (registers) / the
+ * row count (insert-only tables) — what OCC validation compares. */
+struct StateMachine {
+    std::map<long long, long long> regs;
+    std::map<long long, long long> reg_ver;
+    std::vector<long long> set_vals;
+    std::map<std::pair<int, long long>,
+             std::vector<std::pair<long long, long long>>> tables;
+
+    void apply(const LogEntry &e, long long lsn) {
+        if (e.kind == 'W') {
+            regs[e.key] = e.a;
+            reg_ver[e.key] = lsn;
+        } else if (e.kind == 'C') {
+            /* CAS entries are logged only when they applied */
+            regs[e.key] = e.b;
+            reg_ver[e.key] = lsn;
+        } else if (e.kind == 'A') {
+            set_vals.push_back(e.a);
+        } else if (e.kind == 'T') {
+            for (const SubOp &s : e.ops) {
+                if (s.kind == 'W') {
+                    regs[s.k] = s.a;
+                    reg_ver[s.k] = lsn;
+                } else if (s.kind == 'I') {
+                    tables[{(int)s.t, s.k}].push_back({s.a, s.b});
+                }
+            }
+        }                               /* 'N' no-op: nothing */
+    }
 };
 
 enum Role { REPLICA = 0, CANDIDATE = 1, PRIMARY = 2 };
@@ -138,15 +189,14 @@ struct Node {
     int leader = -1;
     long long last_leader_contact = 0;      /* mono_ms */
 
-    /* the replicated log; applied state is always the full log.
-     * regs/set_vals are SPECULATIVE (include uncommitted suffix) —
-     * used for cas preconditions, which is safe because a cas entry
-     * sits after its precondition's entry in the log, so truncation
-     * removes both or neither. Reads must NOT see this state. */
+    /* the replicated log; SPEC is always the full log applied —
+     * uncommitted suffix included. cas preconditions and txn
+     * validation run against it, which is safe because a dependent
+     * entry sits after its precondition's entry in the log, so
+     * truncation removes both or neither. Reads must NOT see it. */
     std::vector<LogEntry> log;
     long long applied_lsn = 0;              /* == log.size() */
-    std::map<long long, long long> regs;
-    std::vector<long long> set_vals;
+    StateMachine spec;
 
     /* the COMMITTED prefix — what reads serve in durable mode. An
      * applied-but-unacked write must never reach an observer: if it
@@ -155,8 +205,7 @@ struct Node {
      * This is the durable-LSN read gating of the lrl's
      * RETRIEVE_DURABLE_LSN_AT_BEGIN. */
     long long committed_lsn = 0;
-    std::map<long long, long long> committed_regs;
-    std::vector<long long> committed_set;
+    StateMachine committed;
 
     /* highest lsn VERIFIED to match the current leader's log (by the
      * log-matching induction: an entry accepted after its prev-term
@@ -180,6 +229,28 @@ struct Node {
     std::vector<long long> last_ack;        /* mono_ms of last A reply */
     long long durable_lsn = 0;
     long long known_durable = 0;            /* replicas: from heartbeats */
+
+    /* open client transactions (leader-only; a failover aborts them:
+     * the new leader doesn't know the txid and TC replies FAIL, which
+     * is safe — nothing was applied). Reads record the version of
+     * what they saw; commit validates those versions against the
+     * SPECULATIVE state (log order = serial order, so any newer
+     * write — committed or pending — must abort the txn). */
+    struct TxnRead {
+        char kind;          /* 'R' register, 'P' predicate (table) */
+        int tbl;
+        long long key;
+        long long ver;
+    };
+    struct Txn {
+        std::vector<TxnRead> reads;
+        std::vector<SubOp> writes;
+        long long created_ms = 0;
+    };
+    std::map<long long, Txn> txns;
+    long long next_txid = 1;
+    bool buggy_txn = false;     /* negative control: commit without
+                                 * validation — lost updates / G2 */
 
     /* replay dedup: nonce -> lsn of the entry that applied it. Lives
      * IN the log (entries carry their nonce), so every replica
@@ -210,41 +281,9 @@ struct Node {
      * or counted toward durability — so a majority-acked write
      * survives kill -9 of its whole cohort. Truncations rewrite the
      * file (rare: only divergent-suffix repair). */
-    void persist_append_locked(const LogEntry &e) {
-        if (log_fp == nullptr) return;
-        fprintf(log_fp, "%lld %c %lld %lld %lld %llu\n", e.term, e.kind,
-                e.key, e.a, e.b, e.nonce);
-        if (!no_fsync) {
-            fflush(log_fp);
-            fsync(fileno(log_fp));
-        }
-    }
+    void persist_append_locked(const LogEntry &e);
 
-    void persist_rewrite_locked() {
-        if (log_fp == nullptr) return;
-        /* write-tmp-then-rename (like the meta file): an in-place
-         * "w" truncation would zero the fsync'd log for the duration
-         * of the rewrite, and a kill -9 in that window would lose
-         * COMMITTED entries — exactly the contract this file exists
-         * to keep */
-        std::string tmp = dir + "/log.tmp", path = dir + "/log";
-        FILE *f = fopen(tmp.c_str(), "w");
-        if (f == nullptr) abort();
-        for (const LogEntry &e : log)
-            fprintf(f, "%lld %c %lld %lld %lld %llu\n", e.term,
-                    e.kind, e.key, e.a, e.b, e.nonce);
-        if (!no_fsync) {
-            fflush(f);
-            fsync(fileno(f));
-        }
-        fclose(f);
-        if (rename(tmp.c_str(), path.c_str()) != 0) abort();
-        fclose(log_fp);
-        log_fp = fopen(path.c_str(), "a");
-        if (log_fp == nullptr) abort();
-        if (no_fsync)
-            setvbuf(log_fp, nullptr, _IOFBF, 1 << 20);
-    }
+    void persist_rewrite_locked();
 
     void persist_meta_locked() {
         if (dir.empty()) return;
@@ -261,15 +300,8 @@ struct Node {
     }
 
     void apply_locked(const LogEntry &e) {
-        if (e.kind == 'W') {
-            regs[e.key] = e.a;
-        } else if (e.kind == 'C') {
-            /* CAS entries are logged only when they applied */
-            regs[e.key] = e.b;
-        } else if (e.kind == 'A') {
-            set_vals.push_back(e.a);
-        }                                   /* 'N' no-op: nothing */
         applied_lsn = (long long)log.size();
+        spec.apply(e, applied_lsn);
         if (e.nonce != 0) nonce_lsn[e.nonce] = applied_lsn;
     }
 
@@ -285,13 +317,8 @@ struct Node {
         if (target > (long long)log.size())
             target = (long long)log.size();
         while (committed_lsn < target) {
-            const LogEntry &e = log[(size_t)committed_lsn];
-            if (e.kind == 'W')
-                committed_regs[e.key] = e.a;
-            else if (e.kind == 'C')
-                committed_regs[e.key] = e.b;
-            else if (e.kind == 'A')
-                committed_set.push_back(e.a);
+            committed.apply(log[(size_t)committed_lsn],
+                            committed_lsn + 1);
             committed_lsn++;
         }
     }
@@ -315,8 +342,7 @@ struct Node {
     void truncate_locked(long long lsn) {
         if ((long long)log.size() <= lsn) return;
         log.resize((size_t)lsn);
-        regs.clear();
-        set_vals.clear();
+        spec = StateMachine();
         nonce_lsn.clear();
         applied_lsn = 0;
         std::vector<LogEntry> entries;
@@ -376,6 +402,83 @@ struct Node {
 };
 
 Node g_node;
+
+/* ---------- log entry wire/file serialization --------------------- */
+
+/* txn payload suffix: " <nops> (<kind> <t> <k> <a> <b>)*" */
+std::string entry_payload(const LogEntry &e) {
+    if (e.kind != 'T') return "";
+    std::string s = " " + std::to_string(e.ops.size());
+    for (const SubOp &o : e.ops) {
+        s += " ";
+        s += o.kind;
+        s += " " + std::to_string(o.t) + " " + std::to_string(o.k) +
+             " " + std::to_string(o.a) + " " + std::to_string(o.b);
+    }
+    return s;
+}
+
+/* parse the payload suffix into e->ops; false on malformed input */
+bool parse_payload(const char *p, LogEntry *e) {
+    char *end = nullptr;
+    long long nops = strtoll(p, &end, 10);
+    if (end == p || nops < 0 || nops > 4096) return false;
+    p = end;
+    e->ops.clear();
+    for (long long i = 0; i < nops; i++) {
+        while (*p == ' ') p++;
+        SubOp o;
+        o.kind = *p;
+        if (o.kind != 'W' && o.kind != 'I') return false;
+        p++;
+        long long *fields[4] = {&o.t, &o.k, &o.a, &o.b};
+        for (long long *f : fields) {
+            *f = strtoll(p, &end, 10);
+            if (end == p) return false;
+            p = end;
+        }
+        e->ops.push_back(o);
+    }
+    return true;
+}
+
+/* one log-file line (same grammar as the replication payload tail) */
+void fprint_entry(FILE *f, const LogEntry &e) {
+    fprintf(f, "%lld %c %lld %lld %lld %llu%s\n", e.term, e.kind,
+            e.key, e.a, e.b, e.nonce, entry_payload(e).c_str());
+}
+
+void Node::persist_append_locked(const LogEntry &e) {
+    if (log_fp == nullptr) return;
+    fprint_entry(log_fp, e);
+    if (!no_fsync) {
+        fflush(log_fp);
+        fsync(fileno(log_fp));
+    }
+}
+
+void Node::persist_rewrite_locked() {
+    if (log_fp == nullptr) return;
+    /* write-tmp-then-rename (like the meta file): an in-place "w"
+     * truncation would zero the fsync'd log for the duration of the
+     * rewrite, and a kill -9 in that window would lose COMMITTED
+     * entries — exactly the contract this file exists to keep */
+    std::string tmp = dir + "/log.tmp", path = dir + "/log";
+    FILE *f = fopen(tmp.c_str(), "w");
+    if (f == nullptr) abort();
+    for (const LogEntry &e : log) fprint_entry(f, e);
+    if (!no_fsync) {
+        fflush(f);
+        fsync(fileno(f));
+    }
+    fclose(f);
+    if (rename(tmp.c_str(), path.c_str()) != 0) abort();
+    fclose(log_fp);
+    log_fp = fopen(path.c_str(), "a");
+    if (log_fp == nullptr) abort();
+    if (no_fsync)
+        setvbuf(log_fp, nullptr, _IOFBF, 1 << 20);
+}
 
 /* ---------- small line-protocol client (for forwarding) ----------- */
 
@@ -448,7 +551,7 @@ void sender_thread(int peer) {
     long long last_hb_sent = 0;
     for (;;) {
         char buf[192];
-        bool have_msg = false;
+        std::string msg;
         {
             std::unique_lock<std::mutex> lk(n.mu);
             n.cv.wait_for(lk, std::chrono::milliseconds(n.hb_ms), [&] {
@@ -464,18 +567,18 @@ void sender_thread(int peer) {
                     next >= 2 ? n.log[(size_t)next - 2].term : 0;
                 snprintf(buf, sizeof buf,
                          "E %d %lld %lld %lld %lld %c %lld %lld %lld"
-                         " %lld %llu\n",
+                         " %lld %llu",
                          n.id, n.term, next, e.term, pterm, e.kind,
                          e.key, e.a, e.b, n.durable_lsn, e.nonce);
-                have_msg = true;
+                msg = buf + entry_payload(e) + "\n";
             } else if (mono_ms() - last_hb_sent >= n.hb_ms) {
                 snprintf(buf, sizeof buf, "H %d %lld %lld\n", n.id,
                          n.term, n.durable_lsn);
-                have_msg = true;
+                msg = buf;
                 last_hb_sent = mono_ms();
             }
         }
-        if (!have_msg) continue;
+        if (msg.empty()) continue;
         if (fd < 0) fd = dial(n.ports[peer], 200);
         if (fd < 0) {
             /* unreachable peer: back off instead of spinning the dial
@@ -484,7 +587,7 @@ void sender_thread(int peer) {
             continue;
         }
         std::string reply;
-        if (!send_all(fd, buf) || !read_line(fd, &reply)) {
+        if (!send_all(fd, msg) || !read_line(fd, &reply)) {
             close(fd);
             fd = -1;
             continue;
@@ -582,7 +685,7 @@ void election_thread() {
             /* the election no-op: lets durable_lsn advance in this
              * term, transitively committing inherited entries; reads
              * are barred until it commits (term_start_lsn) */
-            n.append_locked({t, 'N', 0, 0, 0});
+            n.append_locked({t, 'N', 0, 0, 0, 0, {}});
             n.term_start_lsn = (long long)n.log.size();
             n.recompute_durable_locked();
             n.cv.notify_all();
@@ -600,6 +703,42 @@ void election_thread() {
  * leader / durable wait timed out: the op may still replicate —
  * indeterminate, exactly an :info op). The cas precondition is decided
  * under the same lock as the append, so concurrent cas ops serialize. */
+/* shared tail of every leader-side commit: wait until the appended
+ * (or replayed) entry at ``lsn`` is covered by the durable LSN.
+ * Replayed entries may commit under ANY term (inherited by a later
+ * leader) — only durable coverage matters; fresh entries require the
+ * leader to still be in the appending term. */
+std::string commit_wait(long long lsn, long long t, bool replay) {
+    Node &n = g_node;
+    n.cv.notify_all();
+    if (!n.durable) return "OK " + std::to_string(lsn);
+    std::unique_lock<std::mutex> lk(n.mu);
+    if (n.split_brain && !n.lease_fresh_locked()) {
+        /* the split-brain control: a quorum-less leader acks anyway —
+         * the divergent write the checker must catch */
+        return "OK " + std::to_string(lsn);
+    }
+    if (replay) {
+        bool ok = n.cv.wait_for(lk,
+                                std::chrono::milliseconds(n.timeout_ms),
+                                [&] {
+                                    return n.durable_lsn >= lsn ||
+                                           n.role != PRIMARY;
+                                });
+        if (ok && n.durable_lsn >= lsn)
+            return "OK " + std::to_string(lsn);
+        return "UNKNOWN";
+    }
+    bool ok = n.cv.wait_for(lk, std::chrono::milliseconds(n.timeout_ms),
+                            [&] {
+                                return n.durable_lsn >= lsn ||
+                                       n.term != t || n.role != PRIMARY;
+                            });
+    if (ok && n.durable_lsn >= lsn && n.term == t)
+        return "OK " + std::to_string(lsn);
+    return "UNKNOWN";       /* deposed or timed out: indeterminate */
+}
+
 std::string primary_commit(const LogEntry &e0, bool is_cas = false) {
     Node &n = g_node;
     LogEntry e = e0;
@@ -623,8 +762,8 @@ std::string primary_commit(const LogEntry &e0, bool is_cas = false) {
         }
         if (!replay) {
             if (is_cas) {
-                auto it = n.regs.find(e.key);
-                if (it == n.regs.end() || it->second != e.a)
+                auto it = n.spec.regs.find(e.key);
+                if (it == n.spec.regs.end() || it->second != e.a)
                     return "FAIL";
             }
             e.term = t = n.term;
@@ -633,35 +772,74 @@ std::string primary_commit(const LogEntry &e0, bool is_cas = false) {
             n.recompute_durable_locked();
         }
     }
-    n.cv.notify_all();
-    if (!n.durable) return "OK " + std::to_string(lsn);
-    std::unique_lock<std::mutex> lk(n.mu);
-    if (n.split_brain && !n.lease_fresh_locked()) {
-        /* the split-brain control: a quorum-less leader acks anyway —
-         * the divergent write the checker must catch */
-        return "OK " + std::to_string(lsn);
+    return commit_wait(lsn, t, replay);
+}
+
+/* commit a client transaction: validate its read versions against the
+ * SPECULATIVE state (log order = serial order — any newer write to a
+ * read key/predicate, committed or pending, aborts), then append ONE
+ * 'T' entry with all buffered writes and wait for durability. The
+ * validation, txn consumption, and append share one lock acquisition
+ * with every other commit, so the serial point is the log position.
+ * --buggy-txn (-T) skips validation — the lost-update / G2 control. */
+std::string commit_txn(long long txid, unsigned long long nonce) {
+    Node &n = g_node;
+    LogEntry e;
+    long long lsn = 0, t = 0;
+    bool replay = false;
+    {
+        std::lock_guard<std::mutex> g(n.mu);
+        if (n.role != PRIMARY) return "UNKNOWN";
+        if (nonce != 0 && !n.no_dedup) {
+            auto it = n.nonce_lsn.find(nonce);
+            if (it != n.nonce_lsn.end()) {
+                lsn = it->second;
+                t = n.log[(size_t)lsn - 1].term;
+                replay = true;
+            }
+        }
+        if (!replay) {
+            auto it = n.txns.find(txid);
+            if (it == n.txns.end())
+                return "FAIL";  /* aborted / deposed / expired: clean
+                                 * abort — nothing was applied */
+            Node::Txn txn = std::move(it->second);
+            n.txns.erase(it);
+            if (!n.buggy_txn) {
+                for (const Node::TxnRead &r : txn.reads) {
+                    long long cur = 0;
+                    if (r.kind == 'R') {
+                        auto v = n.spec.reg_ver.find(r.key);
+                        cur = v == n.spec.reg_ver.end() ? 0
+                                                        : v->second;
+                    } else {
+                        auto v = n.spec.tables.find({r.tbl, r.key});
+                        cur = v == n.spec.tables.end()
+                                  ? 0
+                                  : (long long)v->second.size();
+                    }
+                    if (cur != r.ver) return "FAIL";    /* conflict */
+                }
+            }
+            if (txn.writes.empty()) {
+                /* read-only: its commit point is now; needs the same
+                 * lease + read barrier as a plain read */
+                if (!n.durable ||
+                    (n.lease_fresh_locked() &&
+                     n.durable_lsn >= n.term_start_lsn))
+                    return "OK " + std::to_string(n.durable_lsn);
+                return "UNKNOWN";
+            }
+            e.kind = 'T';
+            e.ops = std::move(txn.writes);
+            e.nonce = nonce;
+            e.term = t = n.term;
+            n.append_locked(e);
+            lsn = (long long)n.log.size();
+            n.recompute_durable_locked();
+        }
     }
-    if (replay) {
-        /* the entry may have committed under ANY term (inherited by a
-         * later leader): only durable coverage matters */
-        bool ok = n.cv.wait_for(lk,
-                                std::chrono::milliseconds(n.timeout_ms),
-                                [&] {
-                                    return n.durable_lsn >= lsn ||
-                                           n.role != PRIMARY;
-                                });
-        if (ok && n.durable_lsn >= lsn)
-            return "OK " + std::to_string(lsn);
-        return "UNKNOWN";
-    }
-    bool ok = n.cv.wait_for(lk, std::chrono::milliseconds(n.timeout_ms),
-                            [&] {
-                                return n.durable_lsn >= lsn ||
-                                       n.term != t || n.role != PRIMARY;
-                            });
-    if (ok && n.durable_lsn >= lsn && n.term == t)
-        return "OK " + std::to_string(lsn);
-    return "UNKNOWN";       /* deposed or timed out: indeterminate */
+    return commit_wait(lsn, t, replay);
 }
 
 std::string handle(const std::string &line, bool forwarded = false);
@@ -793,10 +971,16 @@ std::string handle(const std::string &line, bool forwarded) {
                   b = 0, edur = 0;
         unsigned long long enonce = 0;
         char kind = 0;
+        int off = 0;
         if (sscanf(line.c_str() + 1,
-                   "%d %lld %lld %lld %lld %c %lld %lld %lld %lld %llu",
+                   "%d %lld %lld %lld %lld %c %lld %lld %lld %lld "
+                   "%llu%n",
                    &from, &eterm, &lsn, &et, &pt, &kind, &key, &a, &b,
-                   &edur, &enonce) < 10)
+                   &edur, &enonce, &off) != 11)
+            return "ERR";
+        LogEntry incoming{et, kind, key, a, b, enonce, {}};
+        if (kind == 'T' &&
+            !parse_payload(line.c_str() + 1 + off, &incoming))
             return "ERR";
         if (lsn < 1) return "ERR";  /* log[lsn-1] below would wrap */
         if (n.blocked_peer(from)) return "ERR";
@@ -820,7 +1004,7 @@ std::string handle(const std::string &line, bool forwarded) {
                 /* previous entry mismatches: force the sender back */
                 n.truncate_locked(lsn - 2);
             } else {
-                n.append_locked({et, kind, key, a, b, enonce});
+                n.append_locked(incoming);
             }
         }
         if (lsn <= n.applied_lsn &&
@@ -845,8 +1029,8 @@ std::string handle(const std::string &line, bool forwarded) {
             if (!n.durable) {
                 /* no-durable control: every node serves its possibly
                  * stale, possibly uncommitted local state */
-                auto it = n.regs.find(key);
-                return it != n.regs.end()
+                auto it = n.spec.regs.find(key);
+                return it != n.spec.regs.end()
                            ? "V " + std::to_string(it->second)
                            : "NIL";
             }
@@ -864,8 +1048,8 @@ std::string handle(const std::string &line, bool forwarded) {
                  * it could be truncated after a failover */
                 if (n.lease_fresh_locked() &&
                     n.durable_lsn >= n.term_start_lsn) {
-                    auto it = n.committed_regs.find(key);
-                    return it != n.committed_regs.end()
+                    auto it = n.committed.regs.find(key);
+                    return it != n.committed.regs.end()
                                ? "V " + std::to_string(it->second)
                                : "NIL";
                 }
@@ -873,8 +1057,8 @@ std::string handle(const std::string &line, bool forwarded) {
                 /* the split-brain control serves its divergent
                  * speculative state off the stale lease — the
                  * anomaly has to be client-visible */
-                auto it = n.regs.find(key);
-                return it != n.regs.end()
+                auto it = n.spec.regs.find(key);
+                return it != n.spec.regs.end()
                            ? "V " + std::to_string(it->second)
                            : "NIL";
             }
@@ -894,10 +1078,136 @@ std::string handle(const std::string &line, bool forwarded) {
          * set element could be truncated after failover, and a reader
          * that saw it would report a "flickering" element */
         const std::vector<long long> &vals =
-            n.durable ? n.committed_set : n.set_vals;
+            n.durable ? n.committed.set_vals : n.spec.set_vals;
         std::string out = "V";
         for (long long v : vals) out += " " + std::to_string(v);
         return out;
+    }
+    if (cmd == 'T' && line.size() >= 2) {
+        /* transaction verbs (the begin/op/commit surface the sut.h
+         * ABI lacked — VERDICT Missing #2). Txn state lives on the
+         * leader; every verb forwards like a mutation, so a client
+         * can drive one txn through any node. A failover aborts open
+         * txns cleanly (unknown txid -> FAIL, nothing applied).
+         *   TB                  -> "T <txid>"
+         *   TR <txid> <k>       -> "V <v>" | "NIL"    committed read
+         *   TP <txid> <a|b> <k> -> "V id:val ..."     predicate read
+         *   TW <txid> <k> <v>   -> "OK"               buffer write
+         *   TI <txid> <a|b> <k> <id> <v> -> "OK"      buffer insert
+         *   TA <txid>           -> "OK"               abort
+         *   TC <txid> [nonce]   -> "OK <lsn>" | "FAIL" | "UNKNOWN"
+         */
+        char sub = line[1];
+        bool am_leader;
+        {
+            std::lock_guard<std::mutex> g(n.mu);
+            am_leader = n.role == PRIMARY;
+        }
+        if (!am_leader) {
+            if (forwarded) return "UNKNOWN";
+            return forward_to_leader(line);
+        }
+        const char *args = line.c_str() + 2;
+        if (sub == 'B') {
+            std::lock_guard<std::mutex> g(n.mu);
+            long long now = mono_ms();
+            /* expire abandoned txns so crashed clients can't leak */
+            for (auto it = n.txns.begin(); it != n.txns.end();) {
+                if (now - it->second.created_ms > 60000)
+                    it = n.txns.erase(it);
+                else
+                    ++it;
+            }
+            long long txid = n.next_txid++;
+            n.txns[txid].created_ms = now;
+            return "T " + std::to_string(txid);
+        }
+        if (sub == 'C') {
+            long long txid = 0;
+            unsigned long long nonce = 0;
+            if (sscanf(args, "%lld %llu", &txid, &nonce) < 1)
+                return "ERR";
+            return commit_txn(txid, nonce);
+        }
+        if (sub == 'A') {
+            long long txid = 0;
+            if (sscanf(args, "%lld", &txid) != 1) return "ERR";
+            std::lock_guard<std::mutex> g(n.mu);
+            n.txns.erase(txid);
+            return "OK";
+        }
+        if (sub == 'R') {
+            long long txid = 0, key = 0;
+            if (sscanf(args, "%lld %lld", &txid, &key) != 2)
+                return "ERR";
+            std::lock_guard<std::mutex> g(n.mu);
+            auto it = n.txns.find(txid);
+            if (it == n.txns.end()) return "FAIL";
+            /* committed read (uncommitted data must never escape);
+             * the version of what we read is the committed one — at
+             * commit, any NEWER version (even pending) aborts */
+            auto vv = n.committed.reg_ver.find(key);
+            long long ver =
+                vv == n.committed.reg_ver.end() ? 0 : vv->second;
+            it->second.reads.push_back({'R', 0, key, ver});
+            auto rv = n.committed.regs.find(key);
+            return rv != n.committed.regs.end()
+                       ? "V " + std::to_string(rv->second)
+                       : "NIL";
+        }
+        if (sub == 'P') {
+            long long txid = 0, key = 0;
+            char tc = 0;
+            if (sscanf(args, "%lld %c %lld", &txid, &tc, &key) != 3 ||
+                (tc != 'a' && tc != 'b'))
+                return "ERR";
+            int tbl = tc == 'b' ? 1 : 0;
+            std::lock_guard<std::mutex> g(n.mu);
+            auto it = n.txns.find(txid);
+            if (it == n.txns.end()) return "FAIL";
+            auto tv = n.committed.tables.find({tbl, key});
+            long long count =
+                tv == n.committed.tables.end()
+                    ? 0
+                    : (long long)tv->second.size();
+            it->second.reads.push_back({'P', tbl, key, count});
+            std::string out = "V";
+            if (tv != n.committed.tables.end())
+                for (const auto &row : tv->second)
+                    out += " " + std::to_string(row.first) + ":" +
+                           std::to_string(row.second);
+            return out;
+        }
+        if (sub == 'W') {
+            long long txid = 0, key = 0, v = 0;
+            if (sscanf(args, "%lld %lld %lld", &txid, &key, &v) != 3)
+                return "ERR";
+            std::lock_guard<std::mutex> g(n.mu);
+            auto it = n.txns.find(txid);
+            if (it == n.txns.end()) return "FAIL";
+            /* the admission cap must stay below parse_payload's 4096
+             * and the recovery line buffer: an entry the replicas or
+             * recovery can't parse would wedge replication forever */
+            if (it->second.writes.size() >= 512) return "ERR";
+            it->second.writes.push_back({'W', 0, key, v, 0});
+            return "OK";
+        }
+        if (sub == 'I') {
+            long long txid = 0, key = 0, rid = 0, v = 0;
+            char tc = 0;
+            if (sscanf(args, "%lld %c %lld %lld %lld", &txid, &tc,
+                       &key, &rid, &v) != 5 ||
+                (tc != 'a' && tc != 'b'))
+                return "ERR";
+            std::lock_guard<std::mutex> g(n.mu);
+            auto it = n.txns.find(txid);
+            if (it == n.txns.end()) return "FAIL";
+            if (it->second.writes.size() >= 512) return "ERR";
+            it->second.writes.push_back(
+                {'I', tc == 'b' ? 1 : 0, key, rid, v});
+            return "OK";
+        }
+        return "ERR";
     }
     if (cmd == 'M' || cmd == 'W' || cmd == 'C' || cmd == 'A') {
         unsigned long long nonce = 0;
@@ -931,11 +1241,11 @@ std::string handle(const std::string &line, bool forwarded) {
             int cnt = sscanf(inner.c_str() + 1, "%lld %lld", &k, &v);
             if (cnt == 1) { v = k; k = 1; }
             else if (cnt != 2) return "ERR";
-            return primary_commit({0, 'W', k, v, 0, nonce});
+            return primary_commit({0, 'W', k, v, 0, nonce, {}});
         }
         if (cmd == 'A') {
             long long v = atoll(inner.c_str() + 1);
-            return primary_commit({0, 'A', 0, v, 0, nonce});
+            return primary_commit({0, 'A', 0, v, 0, nonce, {}});
         }
         /* "C k a b" keyed; "C a b" = key 1 */
         long long k = 0, a = 0, b = 0;
@@ -943,7 +1253,7 @@ std::string handle(const std::string &line, bool forwarded) {
                          &b);
         if (cnt == 2) { b = a; a = k; k = 1; }
         else if (cnt != 3) return "ERR";
-        return primary_commit({0, 'C', k, a, b, nonce},
+        return primary_commit({0, 'C', k, a, b, nonce, {}},
                               /*is_cas=*/true);
     }
     return "ERR";
@@ -973,7 +1283,7 @@ int main(int argc, char **argv) {
     std::string peers;
     int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xNBDh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xNBDTh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
@@ -986,6 +1296,7 @@ int main(int argc, char **argv) {
         case 'D': n.no_dedup = true; break;
         case 'd': n.dir = optarg; break;
         case 'x': n.no_fsync = true; break;
+        case 'T': n.buggy_txn = true; break;
         default:
             fprintf(stderr,
                     "usage: %s -i id -n port0,port1,... [-P leader0] "
@@ -1035,11 +1346,30 @@ int main(int argc, char **argv) {
         }
         std::string log_path = n.dir + "/log";
         if (FILE *f = fopen(log_path.c_str(), "r")) {
-            LogEntry e;
-            while (fscanf(f, "%lld %c %lld %lld %lld %llu", &e.term,
-                          &e.kind, &e.key, &e.a, &e.b, &e.nonce) == 6)
+            char lbuf[65536];
+            long good = 0;      /* offset after the last whole entry */
+            while (fgets(lbuf, sizeof lbuf, f) != nullptr) {
+                LogEntry e;
+                int off = 0;
+                size_t len = strlen(lbuf);
+                if (len == 0 || lbuf[len - 1] != '\n')
+                    break;      /* torn tail: not a whole line */
+                if (sscanf(lbuf, "%lld %c %lld %lld %lld %llu%n",
+                           &e.term, &e.kind, &e.key, &e.a, &e.b,
+                           &e.nonce, &off) != 6)
+                    break;
+                if (e.kind == 'T' && !parse_payload(lbuf + off, &e))
+                    break;
                 n.append_recovered_locked(e);
+                good = ftell(f);
+            }
             fclose(f);
+            /* drop any torn residue BEFORE reopening for append —
+             * otherwise new fsync'd entries land after the garbage
+             * and the NEXT recovery would stop at it and silently
+             * lose them */
+            if (truncate(log_path.c_str(), good) != 0 && errno != ENOENT)
+                perror("truncate log");
             if (!n.log.empty()) recovered = true;
         }
         n.log_fp = fopen(log_path.c_str(), "a");

@@ -109,8 +109,6 @@ def test_restarted_replica_is_backfilled(tmp_path):
     for use_dirs in (True, False):
         ports = _free_ports(3)
         dirs = _dirs(tmp_path / f"d{use_dirs}", 3) if use_dirs else None
-        if not use_dirs:
-            (tmp_path / "dFalse").mkdir(exist_ok=True)
         procs = spawn_cluster(BINARY, ports, durable=True,
                               timeout_ms=500, elect_ms=500,
                               lease_ms=300, dirs=dirs)

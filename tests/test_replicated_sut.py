@@ -359,16 +359,16 @@ def test_replication_protocol_certifies_before_counting():
         # leader 0, term 5: heartbeat certifies nothing yet
         assert conn.request("H 0 5 0") == "A 0"
         # replicate entry 1 (term 5): append + certify
-        assert conn.request("E 0 5 1 5 0 W 1 7 0 0") == "A 1"
+        assert conn.request("E 0 5 1 5 0 W 1 7 0 0 0") == "A 1"
         # duplicate with matching term: still certified at 1
-        assert conn.request("E 0 5 1 5 0 W 1 7 0 0") == "A 1"
+        assert conn.request("E 0 5 1 5 0 W 1 7 0 0 0") == "A 1"
         # leader 2 takes over in term 7: certification RESETS to the
         # committed prefix (0) even though applied is still 1 — the
         # old ack value must not leak into the new leader's counts
         assert conn.request("H 2 7 0") == "A 0"
         # the new leader's entry 1 conflicts (term 7 vs 5): the node
         # truncates its divergent suffix, appends, re-certifies
-        assert conn.request("E 2 7 1 7 0 W 1 9 0 0") == "A 1"
+        assert conn.request("E 2 7 1 7 0 W 1 9 0 0 0") == "A 1"
         # commit it via the piggybacked durable lsn, then verify the
         # committed register state took the REPAIRED value
         assert conn.request("H 2 7 1") == "A 1"

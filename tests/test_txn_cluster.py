@@ -1,0 +1,313 @@
+"""Transactions over the wire: bank and G2 against the replicated SUT.
+
+Round-2 VERDICT Missing #2: the flagship serializability workloads
+(bank transfers, Adya G2) only ever ran against the in-memory sqlish
+backend — they never crossed a network or met a partition. sut_node now
+speaks a begin/read/predicate/write/insert/commit transaction surface
+with server-side OCC validation at commit (the db/toblock.c:1953 role:
+reads record versions, the commit validates them against the log-order
+state and applies all writes as one atomic entry). ``--buggy-txn`` (-T)
+commits WITHOUT validation — the lost-update / G2-anomaly control the
+bank and G2 checkers must catch."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from comdb2_tpu.checker.workloads import bank_checker, g2_checker
+from comdb2_tpu.harness import core, fake
+from comdb2_tpu.harness import generator as G
+from comdb2_tpu.ops.op import Op
+from comdb2_tpu.workloads import comdb2 as W
+from comdb2_tpu.workloads.tcp import (BankTcpClient, ClusterControl,
+                                      ClusterPartitioner, ClusterTxn,
+                                      G2TcpClient, SutConnection,
+                                      spawn_cluster)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(ROOT, "native", "build", "sut_node")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(BINARY),
+                                reason="sut_node not built")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _kill(procs):
+    for p in procs:
+        p.kill()
+    for p in procs:
+        p.wait()
+
+
+def _conn(port, timeout=2.0):
+    c = SutConnection("127.0.0.1", port, timeout_s=timeout)
+    c.connect()
+    return c
+
+
+def test_txn_commit_applies_atomically():
+    """begin / read / write / commit; both writes land atomically and
+    are visible to plain reads and later txns."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800)
+    conn = _conn(ports[0])
+    try:
+        t = ClusterTxn(conn)
+        t.begin()
+        assert t.read(1) is None
+        t.write(1, 10)
+        t.write(2, 20)
+        assert t.commit() == "ok"
+        assert conn.request("R 1") == "V 10"
+        assert conn.request("R 2") == "V 20"
+        t2 = ClusterTxn(conn)
+        t2.begin()
+        assert t2.read(1) == 10
+        assert t2.read(2) == 20
+        assert t2.commit() == "ok"       # read-only commit point
+    finally:
+        conn.close()
+        _kill(procs)
+
+
+def test_txn_occ_conflict_aborts_second():
+    """Two interleaved txns reading the same key: the first commit
+    wins, the second fails validation (its read version moved) — the
+    write-write/read-write conflict rule that keeps transfers
+    serializable. With -T (buggy) BOTH commit: the lost update."""
+    for buggy in (False, True):
+        ports = _free_ports(3)
+        procs = spawn_cluster(BINARY, ports, durable=True,
+                              timeout_ms=800,
+                              flags=["-T"] if buggy else [])
+        conn = _conn(ports[0])
+        try:
+            t0 = ClusterTxn(conn)
+            t0.begin()
+            t0.write(1, 100)
+            assert t0.commit() == "ok"
+
+            t1 = ClusterTxn(conn)
+            t1.begin()
+            b1 = t1.read(1)
+            t2 = ClusterTxn(conn)
+            t2.begin()
+            b2 = t2.read(1)
+            assert b1 == b2 == 100
+            t1.write(1, b1 - 30)
+            t2.write(1, b2 - 50)
+            assert t1.commit() == "ok"
+            second = t2.commit()
+            if buggy:
+                assert second == "ok"        # lost update committed
+                assert conn.request("R 1") == "V 50"
+            else:
+                assert second == "fail"      # validation caught it
+                assert conn.request("R 1") == "V 70"
+        finally:
+            conn.close()
+            _kill(procs)
+
+
+def test_txn_predicate_phantom_detected():
+    """G2's dangerous interleaving at the protocol level: two txns
+    predicate-read (a, k) and (b, k) as empty, both insert. With
+    validation the second commit fails (the predicate's version
+    moved — phantom detection); with -T both commit and the G2 checker
+    flags the key."""
+    for buggy in (False, True):
+        ports = _free_ports(3)
+        procs = spawn_cluster(BINARY, ports, durable=True,
+                              timeout_ms=800,
+                              flags=["-T"] if buggy else [])
+        conn = _conn(ports[0])
+        try:
+            k = 7
+            t1 = ClusterTxn(conn)
+            t1.begin()
+            assert t1.predicate("a", k) == []
+            assert t1.predicate("b", k) == []
+            t2 = ClusterTxn(conn)
+            t2.begin()
+            assert t2.predicate("a", k) == []
+            assert t2.predicate("b", k) == []
+            t1.insert("a", k, 1, 30)
+            t2.insert("b", k, 2, 30)
+            assert t1.commit() == "ok"
+            second = t2.commit()
+
+            outcomes = [("ok" if second == "ok" else "fail")]
+            history = [
+                Op(process=0, type="invoke", f="insert",
+                   value=(k, (1, None)), time=0),
+                Op(process=0, type="ok", f="insert",
+                   value=(k, (1, None)), time=1),
+                Op(process=1, type="invoke", f="insert",
+                   value=(k, (None, 2)), time=2),
+                Op(process=1, type=outcomes[0], f="insert",
+                   value=(k, (None, 2)), time=3),
+            ]
+            res = g2_checker.check(None, None, history)
+            if buggy:
+                assert second == "ok"
+                assert res["valid?"] is False, res
+            else:
+                assert second == "fail"
+                assert res["valid?"] is True, res
+        finally:
+            conn.close()
+            _kill(procs)
+
+
+def _bank_test(tmp_path, ports, name, n=5, **kw):
+    t = fake.noop_test()
+    t.update({
+        "nodes": [], "concurrency": 5, "name": name,
+        "store-root": str(tmp_path / "store"),
+        "client": BankTcpClient(ports, n=n, timeout_s=0.6),
+        "model": {"n": n, "total": n * 10},
+        "_bank_n": n,
+        "generator": G.clients(G.time_limit(4.0, G.stagger(
+            0.01, G.mix([W.bank_read, W.bank_diff_transfer])))),
+        "checker": bank_checker,
+    })
+    t.update(kw)
+    return t
+
+
+def test_bank_over_cluster_valid(tmp_path):
+    """Total balance holds over the durable cluster with no faults."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=500)
+    try:
+        t = _bank_test(tmp_path, ports, "bank-cluster")
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+        reads = [op for op in result["history"]
+                 if op.type == "ok" and op.f == "read"]
+        xfers = [op for op in result["history"]
+                 if op.type == "ok" and op.f == "transfer"]
+        assert len(reads) >= 20 and len(xfers) >= 10, \
+            (len(reads), len(xfers))
+    finally:
+        _kill(procs)
+
+
+def test_bank_over_cluster_valid_under_partition(tmp_path):
+    """The VERDICT #2 done-criterion: the bank total-balance invariant
+    holds over the durable cluster under partition windows that force
+    failovers — conflicted/raced transfers abort or go indeterminate,
+    never half-apply."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=300,
+                          elect_ms=500, lease_ms=300)
+    try:
+        ctl = ClusterControl(ports)
+        nemesis_steps = [G.sleep(0.5), {"type": "info", "f": "start"},
+                         G.sleep(1.2), {"type": "info", "f": "stop"},
+                         G.sleep(0.6), {"type": "info", "f": "start"},
+                         G.sleep(1.2), {"type": "info", "f": "stop"}]
+        t = _bank_test(
+            tmp_path, ports, "bank-cluster-nemesis",
+            nemesis=ClusterPartitioner(ctl, isolate_primary=True),
+            generator=G.nemesis(
+                G.seq(nemesis_steps),
+                G.time_limit(5.5, G.stagger(
+                    0.01, G.mix([W.bank_read, W.bank_diff_transfer])))))
+        result = core.run(t)
+        ctl.heal()
+        assert result["results"]["valid?"] is True, result["results"]
+        reads = [op for op in result["history"]
+                 if op.type == "ok" and op.f == "read"]
+        assert len(reads) >= 10, len(reads)
+    finally:
+        _kill(procs)
+
+
+def test_bank_buggy_txn_control_detected(tmp_path):
+    """-T control end to end: commits skip validation, concurrent
+    transfers race and lose updates, and reads observe totals drifting
+    off the invariant — the bank checker must flag it. The harness run
+    races real threads, so drive the deterministic interleaving too."""
+    # deterministic: two transfers sharing exactly ONE account (0->1
+    # and 1->2). Both read account 1 at the same snapshot; without
+    # validation the second commit blindly overwrites account 1 with
+    # its stale computation and the cluster-wide total drifts — two
+    # transfers over the SAME pair would each rewrite a self-consistent
+    # pair and the sum invariant could never see the lost update.
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800,
+                          flags=["-T"])
+    conn = _conn(ports[0])
+    try:
+        init = ClusterTxn(conn)
+        init.begin()
+        for i in range(3):
+            init.write(i, 10)
+        assert init.commit() == "ok"
+        t1 = ClusterTxn(conn)
+        t1.begin()
+        a0, a1 = t1.read(0), t1.read(1)
+        t2 = ClusterTxn(conn)
+        t2.begin()
+        b1, b2 = t2.read(1), t2.read(2)
+        assert a1 == b1 == 10
+        t1.write(0, a0 - 5)
+        t1.write(1, a1 + 5)          # account 1 -> 15
+        t2.write(1, b1 - 3)          # stale: 10 - 3, clobbers the 15
+        t2.write(2, b2 + 3)
+        assert t1.commit() == "ok"
+        assert t2.commit() == "ok"       # buggy: no validation
+        rd = ClusterTxn(conn)
+        rd.begin()
+        balances = tuple(rd.read(i) for i in range(3))
+        rd.commit()
+        history = [
+            Op(process=0, type="invoke", f="read", value=None, time=0),
+            Op(process=0, type="ok", f="read", value=balances, time=1),
+        ]
+        res = bank_checker.check(None, {"n": 3, "total": 30}, history)
+        assert sum(balances) != 30, balances
+        assert res["valid?"] is False, (balances, res)
+    finally:
+        conn.close()
+        _kill(procs)
+
+
+def test_g2_over_cluster_valid(tmp_path):
+    """The real G2 workload (concurrent keys, two inserts per key)
+    over the wire: at most one insert commits per key."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=500)
+    try:
+        t = fake.noop_test()
+        t.update({
+            "nodes": [], "concurrency": 6, "name": "g2-cluster",
+            "store-root": str(tmp_path / "store"),
+            "client": G2TcpClient(ports, timeout_s=0.6),
+            "model": None,
+            "generator": G.clients(G.time_limit(4.0, W.g2_gen())),
+            "checker": g2_checker,
+        })
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid?"] is True, res
+        # the checker must have actually COUNTED committed inserts —
+        # a valid verdict over zero counted keys is vacuous (an ok op
+        # whose value was dropped would silently skip the count)
+        assert res["legal-count"] >= 5, res
+    finally:
+        _kill(procs)
