@@ -287,6 +287,22 @@ class ClusterControl:
             except (TimeoutError, OSError):
                 pass
 
+    def clock(self, node: int, offset_ms: int) -> bool:
+        """Set node's wall-clock offset (the in-tree ``date -s`` — the
+        K verb). Reset with 0. Returns whether the command landed —
+        best-effort callers (nemeses) ignore it, but deterministic
+        tests must assert it (a silently-dropped clock jump would turn
+        a control-plane failure into a misleading verdict)."""
+        try:
+            return self._req(self.ports[node],
+                             f"K {offset_ms}") == "OK"
+        except (TimeoutError, OSError):
+            return False
+
+    def clocks_reset(self) -> None:
+        for i in range(len(self.ports)):
+            self.clock(i, 0)
+
     def await_replicated(self, timeout_s: float = 10.0) -> bool:
         """Coherency gate: wait until every node's applied LSN matches
         the primary's (the ``blockcoherent.sh:15-37`` role)."""
@@ -390,6 +406,41 @@ class ClusterProcs(list):
         deadline = time.monotonic() + self.wait_s
         for i, port in enumerate(self.ports):
             _wait_ready(self[i], port, deadline, "sut_node")
+
+
+class ClusterClockScrambler:
+    """Nemesis client: on ``start`` scrambles every node's wall clock
+    by a random offset within ±max_skew_ms (the ``clock-scrambler``
+    role, ``nemesis.clj:172-187``, over the SUT's K verb instead of
+    ``date -s``); on ``stop`` resets all clocks. Harmless against the
+    monotonic-lease implementation; the --bad-lease control is what
+    gives it teeth."""
+
+    def __init__(self, control: ClusterControl, rng=None,
+                 max_skew_ms: int = 60_000):
+        import random as _random
+
+        self.control = control
+        self.rng = rng or _random.Random(0)
+        self.max_skew_ms = max_skew_ms
+
+    def setup(self, test, node):
+        return self
+
+    def teardown(self, test):
+        self.control.clocks_reset()
+
+    def invoke(self, test, op):
+        if op["f"] == "start":
+            offs = []
+            for i in range(len(self.control.ports)):
+                off = self.rng.randint(-self.max_skew_ms,
+                                       self.max_skew_ms)
+                self.control.clock(i, off)
+                offs.append(off)
+            return {**op, "value": f"clock offsets {offs}"}
+        self.control.clocks_reset()
+        return dict(op)
 
 
 def spawn_cluster(binary: str, ports, durable: bool = True,

@@ -39,6 +39,10 @@
  *   --no-fsync (-x): log writes sit in a userspace buffer — kill -9
  *     loses the acked tail and the set/linearizable checkers must
  *     catch the loss.
+ *   --bad-lease (-L): lease freshness runs on the node's scramblable
+ *     wall clock instead of monotonic deltas — the K clock nemesis
+ *     can then stretch a deposed leader's dead lease into serving
+ *     stale reads.
  *
  * Topology: all nodes on 127.0.0.1, one port each; node 0 is the
  * initial leader (term 1) so fault-free startup needs no election.
@@ -65,6 +69,9 @@
  *                 --buggy-txn (-T) commits WITHOUT validation — the
  *                 lost-update / G2 negative control
  *   P          -> "PONG"
+ *   K [ms]     -> "OK"   set/reset this node's wall-clock offset (the
+ *                 in-tree clock scrambler; harmless unless --bad-lease
+ *                 (-L) makes the lease math consume the wall clock)
  *   I          -> "I <id> <role> <applied> <durable> <term> <leader>"
  *   B <peer>   -> "OK"   drop traffic with node <peer>  (partition)
  *   U <peer>   -> "OK"   heal one peer;  "U" alone heals all
@@ -171,6 +178,17 @@ struct Node {
                                  * loses the tail (with fsync on, every
                                  * entry is on disk before it is acked
                                  * or counted toward durability) */
+    bool bad_lease = false;     /* negative control: lease freshness is
+                                 * computed from the node's WALL clock
+                                 * (mono + settable offset) instead of
+                                 * monotonic deltas — the clock
+                                 * scrambler can then stretch a stale
+                                 * lease and a deposed leader serves
+                                 * stale reads (the coherency-lease
+                                 * clock sensitivity of
+                                 * bdb/rep.c:639-654) */
+    long long clock_offset_ms = 0;  /* the in-tree "date -s": set by
+                                     * the K verb (clock nemesis) */
     std::string dir;            /* state directory; empty = in-memory */
     FILE *log_fp = nullptr;
     int timeout_ms = 2000;      /* durable-LSN wait (lrl:17 = 2000ms) */
@@ -375,11 +393,19 @@ struct Node {
         }
     }
 
+    /* the clock lease math runs on: monotonic (correct — immune to
+     * wall-clock scrambling) or the node's scramblable wall clock
+     * (--bad-lease control). last_ack is recorded with the same
+     * clock, so a backward clock jump makes elapsed time NEGATIVE and
+     * a dead lease looks fresh forever. */
+    long long lease_now_locked() const {
+        return bad_lease ? mono_ms() + clock_offset_ms : mono_ms();
+    }
+
     /* caller holds mu: does this (durable-mode) leader currently hold
-     * a fresh majority lease? Measured with MONOTONIC deltas since the
-     * last ack from each peer — immune to wall-clock scrambling. */
+     * a fresh majority lease? */
     bool lease_fresh_locked() const {
-        long long now = mono_ms();
+        long long now = lease_now_locked();
         int fresh = 1;                      /* self */
         for (size_t p = 0; p < ports.size(); p++)
             if ((int)p != id && now - last_ack[p] <= lease_ms) fresh++;
@@ -595,7 +621,7 @@ void sender_thread(int peer) {
         long long x = 0;
         if (sscanf(reply.c_str(), "A %lld", &x) == 1) {
             std::lock_guard<std::mutex> g(n.mu);
-            n.last_ack[peer] = mono_ms();
+            n.last_ack[peer] = n.lease_now_locked();
             if (x > n.acked_upto[peer]) {
                 n.acked_upto[peer] = x;
                 n.recompute_durable_locked();
@@ -676,7 +702,7 @@ void election_thread() {
             votes >= (int)n.majority()) {
             n.role = PRIMARY;
             n.leader = n.id;
-            long long nw = mono_ms();
+            long long nw = n.lease_now_locked();
             for (size_t p = 0; p < n.ports.size(); p++) {
                 n.acked_upto[p] = 0;        /* senders re-probe; acks
                                              * fast-forward/regress */
@@ -888,6 +914,18 @@ std::string handle(const std::string &line, bool forwarded) {
                  role_name(n.role), n.applied_lsn, durable, n.term,
                  n.leader);
         return buf;
+    }
+    if (cmd == 'K') {
+        /* the clock nemesis ("date -s" in-tree): set this node's wall
+         * clock offset in ms; "K" alone resets. Harmless against the
+         * correct implementation (leases run on monotonic deltas);
+         * with --bad-lease the lease math consumes this clock and a
+         * backward jump stretches a dead lease. */
+        long long off = 0;
+        sscanf(line.c_str() + 1, "%lld", &off);
+        std::lock_guard<std::mutex> g(n.mu);
+        n.clock_offset_ms = off;
+        return "OK";
     }
     if (cmd == 'B' || cmd == 'U') {
         int peer = -1;
@@ -1283,7 +1321,7 @@ int main(int argc, char **argv) {
     std::string peers;
     int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xNBDTh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xLNBDTh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
@@ -1297,6 +1335,7 @@ int main(int argc, char **argv) {
         case 'd': n.dir = optarg; break;
         case 'x': n.no_fsync = true; break;
         case 'T': n.buggy_txn = true; break;
+        case 'L': n.bad_lease = true; break;
         default:
             fprintf(stderr,
                     "usage: %s -i id -n port0,port1,... [-P leader0] "
@@ -1304,7 +1343,9 @@ int main(int argc, char **argv) {
                     "[-l lease_ms] [-d state_dir] "
                     "[-x (no-fsync control)] [-N (no-durable)] "
                     "[-B (split-brain control)] "
-                    "[-D (no-dedup control)]\n",
+                    "[-D (no-dedup control)] "
+                    "[-T (buggy-txn control)] "
+                    "[-L (bad-lease control)]\n",
                     argv[0]);
             return 2;
         }
@@ -1329,6 +1370,8 @@ int main(int argc, char **argv) {
     }
     n.acked_upto.assign(n.ports.size(), 0);
     n.last_ack.assign(n.ports.size(), mono_ms());
+    /* (bad-lease mode re-records these with the node clock on the
+     * first real acks; the boot values only gate the initial lease) */
 
     bool recovered = false;
     if (!n.dir.empty()) {

@@ -566,3 +566,125 @@ def test_no_dedup_cluster_detected_invalid():
     assert r2 == "FAIL", r2
     assert r3 == "V 6", r3
     assert verdict("fail", r3) is False
+
+
+def test_clock_scrambler_harmless_against_monotonic_leases(tmp_path):
+    """Clock faults now target a real time-dependent mechanism (the
+    serving lease). The CORRECT implementation measures leases with
+    monotonic deltas, so scrambling every node's wall clock — combined
+    with partitions — must not produce an anomaly (seed 61)."""
+    from comdb2_tpu.workloads.tcp import ClusterClockScrambler
+
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=300,
+                          elect_ms=500, lease_ms=300)
+    try:
+        ctl = ClusterControl(ports)
+        part = ClusterPartitioner(ctl, isolate_primary=True)
+        clock = ClusterClockScrambler(ctl, rng=random.Random(61))
+
+        class Both:
+            """partition + clock scrambling in the same windows"""
+
+            def setup(self, test, node):
+                return self
+
+            def teardown(self, test):
+                part.teardown(test)
+                clock.teardown(test)
+
+            def invoke(self, test, op):
+                clock.invoke(test, op)
+                return part.invoke(test, op)
+
+        t = _cluster_test(
+            tmp_path, ports, "cluster-clock-scramble",
+            nemesis=Both(),
+            generator=_nemesis_gen(seed=61, secs=6.0, window=1.5,
+                                   lead=0.4, gap=0.7))
+        result = core.run(t)
+        ctl.clocks_reset()
+        ctl.heal()
+        assert result["results"]["valid?"] is True, \
+            ("seed 61", result["results"])
+    finally:
+        _kill(procs)
+
+
+def test_bad_lease_clock_fault_serves_stale_read():
+    """The -L control, DETERMINISTIC: a backward clock jump on a
+    partitioned leader stretches its dead lease (elapsed time goes
+    negative), so it keeps serving its committed-but-now-stale
+    register after the majority elects a new leader and commits a new
+    value — the stale-lease read the checker must flag. The same
+    sequence against the correct (monotonic) cluster yields UNKNOWN
+    from the deposed leader instead."""
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.op import Op
+    from comdb2_tpu.workloads.tcp import SutConnection
+
+    def run_once(bad_lease):
+        ports = _free_ports(3)
+        procs = spawn_cluster(BINARY, ports, durable=True,
+                              timeout_ms=400, elect_ms=500,
+                              lease_ms=300,
+                              flags=["-L"] if bad_lease else [])
+        ctl = ClusterControl(ports)
+
+        def req(port, line, timeout=1.5):
+            conn = SutConnection("127.0.0.1", port, timeout_s=timeout)
+            try:
+                conn.connect()
+                return conn.request(line)
+            except TimeoutError:
+                return "TIMEOUT"
+            finally:
+                conn.close()
+
+        try:
+            assert req(ports[0], "W 1 5").startswith("OK")
+            # cut the leader off and immediately drag its clock 60s
+            # backward — with -L its lease can never expire
+            ctl.partition([0], [1, 2])
+            assert ctl.clock(0, -60_000), "clock command never landed"
+            # the majority side elects and commits a NEW value
+            deadline = time.monotonic() + 6.0
+            new_leader = None
+            while time.monotonic() < deadline and new_leader is None:
+                for info in ctl.info():
+                    if info["role"] == "primary" and info["node"] != 0:
+                        new_leader = info["node"]
+                time.sleep(0.05)
+            assert new_leader is not None, "no election"
+            assert req(ports[new_leader], "W 1 7").startswith("OK")
+            # read via the deposed-but-clock-frozen old leader
+            stale = req(ports[0], "R 1", timeout=1.2)
+            fresh = req(ports[new_leader], "R 1")
+            assert fresh == "V 7"
+            return stale
+        finally:
+            ctl.clocks_reset()
+            ctl.heal()
+            _kill(procs)
+
+    # correct implementation: the deposed leader refuses to serve
+    stale = run_once(bad_lease=False)
+    assert stale in ("UNKNOWN", "TIMEOUT"), stale
+
+    # -L control: the stale read escapes, and the checker flags the
+    # resulting history (write 5 ok; write 7 ok; read 7; then read 5
+    # strictly after — no linearization allows the register to go back)
+    stale = run_once(bad_lease=True)
+    assert stale == "V 5", \
+        ("bad-lease leader should have served its stale register",
+         stale)
+    h = [Op(process=0, type="invoke", f="write", value=5, time=0),
+         Op(process=0, type="ok", f="write", value=5, time=1),
+         Op(process=1, type="invoke", f="write", value=7, time=2),
+         Op(process=1, type="ok", f="write", value=7, time=3),
+         Op(process=2, type="invoke", f="read", value=None, time=4),
+         Op(process=2, type="ok", f="read", value=7, time=5),
+         Op(process=3, type="invoke", f="read", value=None, time=6),
+         Op(process=3, type="ok", f="read", value=5, time=7)]
+    assert analysis(cas_register(), h, backend="host").valid is False
