@@ -290,8 +290,8 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
             # the kernel's frontier is fixed at 128: histories that
             # overflowed it get their requested budget F through the
             # XLA engines instead of surfacing spurious UNKNOWNs
-            unk = np.flatnonzero(status == LJ.UNKNOWN)
-            if unk.size and F > PSEG.F:
+            unk = escalation_indices(status, F, PSEG.F)
+            if unk.size:
                 sub = PackedBatch(
                     packeds=[batch.packeds[i] for i in unk],
                     memo=batch.memo,
@@ -302,9 +302,8 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
                 st2, fa2, n2 = check_batch(sub, F=F, mesh=mesh,
                                            engine=pick_xla_engine(),
                                            info=sub_info)
-                status[unk] = st2
-                fail_at[unk] = fa2
-                n_final[unk] = n2
+                status, fail_at, n_final = merge_escalation(
+                    status, fail_at, n_final, unk, st2, fa2, n2)
                 if info is not None:    # the label must not claim the
                     info["escalated"] = {  # kernel checked everything
                         "engine": sub_info.get("engine"),
@@ -339,6 +338,31 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
         out = LJ.check_device_batch(succ, batch.kind, batch.proc, batch.tr,
                                     F=F, P=P, **sizes)
     return tuple(np.asarray(x) for x in out)
+
+
+def escalation_indices(status: np.ndarray, F: int,
+                       kernel_f: int) -> np.ndarray:
+    """Pure: which batch indices must re-run through the XLA engines.
+    Only UNKNOWN verdicts escalate, and only when the caller's
+    requested frontier budget actually EXCEEDS the fused kernel's
+    fixed one — re-running at the same budget could only reproduce the
+    overflow."""
+    if F <= kernel_f:
+        return np.empty(0, np.int64)
+    return np.flatnonzero(np.asarray(status) == LJ.UNKNOWN)
+
+
+def merge_escalation(status, fail_at, n_final, idx, st2, fa2, n2):
+    """Pure: fold the escalated sub-batch's verdicts back into the
+    full-batch arrays at ``idx`` (unit-testable on CPU — round-2 Weak
+    #2)."""
+    status = np.array(status, np.int32)
+    fail_at = np.array(fail_at, np.int64)
+    n_final = np.array(n_final, np.int32)
+    status[idx] = st2
+    fail_at[idx] = fa2
+    n_final[idx] = n2
+    return status, fail_at, n_final
 
 
 def _pad_batch_axis(sb: SegmentBatch, extra: int):

@@ -783,22 +783,47 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
     # them (one independent dispatch per device, all in flight at
     # once — data parallelism with zero cross-device communication)
     devs = list(devices) if devices else [None]
-    group = min(MAX_STREAM_B, -(-B // len(devs))) if devs[0] is not None \
-        else MAX_STREAM_B
-    slices = [segs_list[i:i + group] for i in range(0, B, group)]
+    plan = plan_stream_slices(B, len(devs) if devs[0] is not None
+                              else 0)
     pending = []
-    for j, sl in enumerate(slices):
-        dev = devs[j % len(devs)]
-        pending.append(_stream_dispatch(succ, sl, spec, n_states,
-                                        n_transitions, dev))
+    for start, end, dev_ix in plan:
+        dev = devs[dev_ix] if devs[0] is not None else None
+        pending.append(_stream_dispatch(succ, segs_list[start:end],
+                                        spec, n_states, n_transitions,
+                                        dev))
     out = []
-    for (res, starts), sl in zip(pending, slices):
+    for (res, starts), (start, end, _) in zip(pending, plan):
         res = np.asarray(res)       # blocks on THIS slice's device only
-        for b in range(len(sl)):
-            st = int(res[b, 0])
-            fail_g = int(res[b, 1])
-            fail_local = fail_g - int(starts[b]) if fail_g >= 0 else -1
-            out.append((st, fail_local, int(res[b, 2])))
+        out.extend(merge_stream_slice(res, starts, end - start))
+    return out
+
+
+def plan_stream_slices(B: int, n_devices: int,
+                       max_stream_b: Optional[int] = None):
+    """Pure slice assignment for the streamed kernel (unit-testable on
+    CPU — round-2 Weak #2: this logic previously ran with >1 device
+    exactly nowhere). Returns ``[(start, end, device_index), ...]``
+    covering ``range(B)`` in order: slices are capped at
+    ``max_stream_b`` histories (VMEM results-buffer bound) and, when
+    ``n_devices`` > 0, also sized to spread the whole batch across the
+    devices round-robin."""
+    cap = MAX_STREAM_B if max_stream_b is None else max_stream_b
+    group = min(cap, -(-B // n_devices)) if n_devices > 0 else cap
+    return [(i, min(i + group, B),
+             ((i // group) % n_devices) if n_devices > 0 else 0)
+            for i in range(0, B, group)]
+
+
+def merge_stream_slice(res: np.ndarray, starts, n: int):
+    """Pure per-slice verdict unpacking: the kernel reports fail
+    segments in slice-global coordinates; callers need them history-
+    local. Returns ``[(status, fail_seg_local, n_final), ...]``."""
+    out = []
+    for b in range(n):
+        st = int(res[b, 0])
+        fail_g = int(res[b, 1])
+        fail_local = fail_g - int(starts[b]) if fail_g >= 0 else -1
+        out.append((st, fail_local, int(res[b, 2])))
     return out
 
 

@@ -5,7 +5,13 @@ The role of ``knossos/linear/report.clj`` (``render-analysis!``,
 the point where the frontier died, the crashing op highlighted, and the
 surviving frontier's model states at death listed alongside. Rendered on
 a rank-based (time-warped) x axis like the reference, so dense regions
-stay readable."""
+stay readable.
+
+Failed linearization orders are drawn SPATIALLY (``report.clj:385-647``):
+each path is an arrow chain over the time grid, hopping from op bar to
+op bar in linearization order with the resulting model state labeled on
+each hop and the inconsistent step in red — plus a per-path mini
+timeline beneath for paths whose ops fall outside the window."""
 
 from __future__ import annotations
 
@@ -17,6 +23,7 @@ from .svg import SVG
 
 BAR = {"ok": "#B7FFB7", "fail": "#FFD4D5", "info": "#FEFFC1",
        None: "#C1DEFF"}
+PATH_COLORS = ["#7A4DD8", "#0B7285", "#B8860B", "#C2255C"]
 ROW_H = 22
 WINDOW = 40  # ops of context on each side of the failure
 
@@ -31,17 +38,22 @@ def render_analysis(history: Sequence[Op], analysis,
     hi = min(len(ops), (fail_at or 0) + WINDOW)
     window = ops[lo:hi]
 
-    # pair invocations with completions inside the window
-    spans = []      # (process, f, value, start-rank, end-rank, type)
+    # pair invocations with completions inside the window; keep BOTH
+    # the invoked and the completed value — final paths describe ops
+    # by their back-filled (completed) values, the bar label by the
+    # invoked one
+    spans = []  # (process, f, inv_value, comp_value, r0, r1, type)
     inflight = {}
     for rank, op in enumerate(window):
         if op.type == "invoke":
             inflight[op.process] = (rank, op)
         elif op.process in inflight:
             r0, inv = inflight.pop(op.process)
-            spans.append((op.process, inv.f, inv.value, r0, rank, op.type))
+            spans.append((op.process, inv.f, inv.value, op.value,
+                          r0, rank, op.type))
     for p, (r0, inv) in inflight.items():
-        spans.append((p, inv.f, inv.value, r0, len(window), None))
+        spans.append((p, inv.f, inv.value, inv.value, r0, len(window),
+                      None))
 
     procs = sorted({s[0] for s in spans}, key=repr)
     prow = {p: i for i, p in enumerate(procs)}
@@ -49,10 +61,23 @@ def render_analysis(history: Sequence[Op], analysis,
 
     width, left = 980, 90
     lane = (width - left - 240) / n
-    path_lines = _layout_paths(list(_paths_of(analysis))[:8],
-                               left, width - 30)
+    paths = list(_paths_of(analysis))[:4]
+    # anchor paths to grid bars up front: anchorable paths draw over
+    # the grid, the rest get mini timelines (and size the canvas)
+    anchors = _span_anchors(spans, prow, left, lane)
+    anchored, rest = [], []
+    for p in paths:
+        op_steps = [s for s in p
+                    if isinstance(s, dict)
+                    and isinstance(s.get("op"), dict)]
+        pts = [_anchor_for(s, anchors) for s in op_steps]
+        if pts and all(pts):
+            anchored.append((p, op_steps, pts))
+        else:
+            rest.append(p)
+    rest_lines = _layout_paths(rest, left, width - 30)
     height = (60 + ROW_H * max(len(procs), 1) + 16 * 12
-              + (40 + 18 * len(path_lines) if path_lines else 0))
+              + (60 + 18 * len(rest_lines) if rest_lines else 20))
     svg = SVG(width, int(height))
     svg.text(width / 2, 16, "linearizability counterexample", size=13,
              anchor="middle")
@@ -64,7 +89,7 @@ def render_analysis(history: Sequence[Op], analysis,
                  stroke="#eee")
 
     fail_rank = (fail_at - lo) if fail_at is not None else None
-    for (p, f, value, r0, r1, typ) in spans:
+    for (p, f, value, _cv, r0, r1, typ) in spans:
         y = 40 + prow[p] * ROW_H + 2
         x0 = left + r0 * lane
         w = max((r1 - r0) * lane, 3)
@@ -85,27 +110,70 @@ def render_analysis(history: Sequence[Op], analysis,
         svg.text(x, 30, "frontier died here", size=9, fill="#c0392b",
                  anchor="middle")
 
+    # --- failed linearization orders, spatially ----------------------
+    # (knossos/linear/report.clj:385-647): each path hops across the
+    # op bars of the grid in linearization order; every hop is labeled
+    # with the model state it produced and the inconsistent step is
+    # red. Paths whose ops can't all be anchored to a bar in the
+    # window fall back to a per-path mini timeline below.
+    overlaid = 0
+    for pi, (p, op_steps, pts) in enumerate(anchored):
+        color = PATH_COLORS[pi % len(PATH_COLORS)]
+        # a path may start with string "prologue" steps describing the
+        # entry state ("(state before N returns)")
+        prologue = [s for s in p if s not in op_steps]
+        overlaid += 1
+        prev = None
+        for si, (step, (ax, ay)) in enumerate(zip(op_steps, pts)):
+            dead = step.get("model") == "inconsistent"
+            # nudge per path so overlapping chains stay tellable
+            ax += (pi - len(anchored) / 2) * 3
+            if prev is None:
+                if prologue:
+                    # entry state from the prologue, at the first dot
+                    svg.text(ax, ay - 9 - 4 * pi,
+                             "from " + _state_label(
+                                 prologue[-1].get("model")),
+                             size=8, fill=color, anchor="middle")
+            else:
+                px, py_ = prev
+                svg.line(px, py_, ax, ay,
+                         stroke="#c0392b" if dead else color,
+                         width=1.4 if dead else 1.1)
+            # the model state this hop produced, beside the dot
+            svg.text(ax + 5, ay - 5,
+                     _state_label(step.get("model")), size=8,
+                     fill="#c0392b" if dead else color)
+            svg.circle(ax, ay, 3.4 if dead else 2.6,
+                       fill="#c0392b" if dead else color,
+                       title=f"{step.get('op')!r} -> "
+                             f"{step.get('model')!r}")
+            prev = (ax, ay)
+
     y = 52 + ROW_H * max(len(procs), 1)
+    if overlaid:
+        svg.text(left, y, f"{overlaid} failed linearization orders "
+                          "drawn over the grid — each hop is labeled "
+                          "with the model state it produced; the red "
+                          "hop made the model inconsistent",
+                 size=9, fill="#555")
+        y += 14
+
     svg.text(left, y, "surviving configs at death:", size=10)
     configs = list(getattr(analysis, "configs", []) or [])[:10]
     for i, cfg in enumerate(configs):
         svg.text(left, y + 14 + 13 * i, f"  {cfg}", size=9, fill="#444")
     if not configs:
         svg.text(left, y + 14, "  (none recorded)", size=9, fill="#444")
+    y += 20 + 13 * max(len(configs), 1)
 
-    # --- failed linearization orders (final paths) -------------------
-    # the role of the reference's model-transition rendering
-    # (knossos/linear/report.clj:385,629): each path is a chain of
-    # op -> resulting-state chips ending where the model went
-    # inconsistent; long chains wrap so the dying (red) step is never
-    # clipped off-canvas
-    if path_lines:
-        y += 20 + 13 * max(len(configs), 1)
+    # per-path mini timelines for unanchorable paths
+    if rest_lines:
         svg.text(left, y, "failed linearization orders "
                           "(each order dies at the red step):",
                  size=10)
         y += 8
-        for li, line in enumerate(path_lines):
+        for li, line in enumerate(rest_lines):
             py = y + 18 * (li + 1)
             for (x, w, label, dead, arrow, title) in line:
                 svg.rect(x, py - 11, w, 15,
@@ -124,6 +192,40 @@ def render_analysis(history: Sequence[Op], analysis,
         with open(path, "w") as fh:
             fh.write(out)
     return out
+
+
+def _span_anchors(spans, prow, left: float, lane: float):
+    """(process, f, value) -> (x, y) canvas anchor at the CENTER of
+    that op's bar in the grid; registered under both the invoked and
+    the completed value (final paths use back-filled values). Pending
+    (still-open) spans win over completed ones with the same
+    signature: final paths linearize pending calls."""
+    anchors = {}          # key -> (x, y, was_pending)
+    for (p, f, inv_v, comp_v, r0, r1, typ) in spans:
+        y = 40 + prow[p] * ROW_H + (ROW_H - 6) / 2 + 2
+        x = left + (r0 + r1) / 2 * lane
+        for value in {repr(inv_v), repr(comp_v)}:
+            key = (repr(p), repr(f), value)
+            prev = anchors.get(key)
+            # pending beats completed (final paths linearize pending
+            # calls); among equals the LATEST occurrence wins — a
+            # retried identical op's path step refers to the most
+            # recent call, not the first
+            if prev is None or typ is None or not prev[2]:
+                anchors[key] = (x, y, typ is None)
+    return {k: (x, y) for k, (x, y, _) in anchors.items()}
+
+
+def _anchor_for(step, anchors):
+    op_d = step.get("op") if isinstance(step, dict) else None
+    if not isinstance(op_d, dict):
+        return None
+    return anchors.get((repr(op_d.get("process")), repr(op_d.get("f")),
+                        repr(op_d.get("value"))))
+
+
+def _state_label(model) -> str:
+    return "⊥" if model == "inconsistent" else str(model)[:18]
 
 
 def _paths_of(analysis):

@@ -72,8 +72,72 @@ def _cross_model_cases():
             return mk_model(), h
         return case
 
+    def bounded_queue_case(rng):
+        """Queue histories whose memoized state space FITS the fused
+        kernel's 4096-entry table: the cross-model generator enqueues
+        globally unique values, whose multiset state space blows past
+        every bucket (round-2 Weak #1: 10/120 queue seeds device-
+        checked). The memo closure applies each distinct transition up
+        to the depth bound regardless of how often the history invokes
+        it, so the state count is ~multisets over the alphabet with
+        total <= invocations — a 2-value alphabet with 10-16 events
+        keeps states <= 64 (measured: 60/60 fit) while still
+        exercising multiset semantics (duplicate values in flight)."""
+        from comdb2_tpu.models import model as M
+
+        h = _bounded_queue_history(rng, rng.randint(2, 4),
+                                   rng.randint(10, 16))
+        if rng.random() < 0.4:
+            h = corrupt(rng, h)
+        return M.unordered_queue(), h
+
     return ([("register", _register_case)] +
-            [(name, mk(mkm, mkh)) for name, mkm, mkh in X.CASES])
+            [(name,
+              bounded_queue_case if name == "unordered-queue"
+              else mk(mkm, mkh))
+             for name, mkm, mkh in X.CASES])
+
+
+def _bounded_queue_history(rng, n_procs, n_events):
+    """Valid unordered-queue execution over alphabet {0,1}."""
+    import collections
+
+    from comdb2_tpu.ops.op import Op
+
+    def invoke(p, f, v):
+        return Op(process=p, type="invoke", f=f, value=v, time=0)
+
+    def ok(p, f, v):
+        return Op(process=p, type="ok", f=f, value=v, time=0)
+
+    def fail(p, f, v):
+        return Op(process=p, type="fail", f=f, value=v, time=0)
+
+    q = collections.deque()
+    procs = {i: None for i in range(n_procs)}
+    h = []
+    while len(h) < n_events:
+        p = rng.randrange(n_procs)
+        if procs[p] is None:
+            if rng.random() < 0.5:
+                v = rng.randrange(2)
+                procs[p] = ("enqueue", v)
+                h.append(invoke(p, "enqueue", v))
+            else:
+                procs[p] = ("dequeue", None)
+                h.append(invoke(p, "dequeue", None))
+        else:
+            f, v = procs[p]
+            procs[p] = None
+            if f == "enqueue":
+                q.append(v)
+                h.append(ok(p, f, v))
+            elif q:
+                got = q.popleft() if rng.random() < 0.5 else q.pop()
+                h.append(ok(p, f, got))
+            else:
+                h.append(fail(p, f, None))
+    return h
 
 
 def main() -> None:
@@ -146,12 +210,35 @@ def main() -> None:
                 assert n_f == n2, f"{name} seed={seed}: {n_f} vs {n2}"
             c[name, "ok" if st == 0
               else ("inv" if st == 1 else "unk")] += 1
+            if st == 2:
+                # re-check UNKNOWNs through the XLA ladder at a wider
+                # frontier: a kernel bug masquerading as an F=128
+                # overflow must not hide behind the unk verdict
+                # (round-2 Weak #6). Definitive resolution recorded;
+                # a still-unk at F=1024 would be unexplained.
+                st3, _, _ = LJ.check_device_seg(
+                    succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
+                    segs.depth, F=1024, P=P, n_states=bucket[0],
+                    n_transitions=bucket[1])
+                st3 = int(st3)
+                c[name, {0: "unk-resolved-valid",
+                         1: "unk-resolved-invalid",
+                         2: "unk-unexplained"}[st3]] += 1
+                assert st3 != 2, \
+                    f"{name} seed={seed}: unk persists at F=1024"
             stream_groups.setdefault((bucket, P), []).append(
                 (succ, segs, r))
         print(name, {k[1]: v for k, v in c.items() if k[0] == name},
               flush=True)
     assert any(c[nm, "ok"] for nm in names)
     assert any(c[nm, "inv"] for nm in names)
+    # queue-family coverage floor (round-2 Weak #1: 10/120): the
+    # bounded-alphabet generator must put the vast majority of queue
+    # seeds THROUGH the device kernel instead of skipping on shape
+    q_checked = sum(c["unordered-queue", k]
+                    for k in ("ok", "inv", "unk"))
+    assert q_checked >= (2 * n) // 3, \
+        f"unordered-queue device coverage {q_checked}/{n}"
 
     # --- stream stage: batched verdicts must match single-history ----
     n_streamed = 0

@@ -199,3 +199,22 @@ def test_independent_failures_get_per_key_svgs(tmp_path):
     assert r["valid?"] is False and sorted(r["failures"]) == [3, 7]
     for k in (3, 7):
         assert (tmp_path / "independent" / str(k) / "linear.svg").exists()
+
+
+def test_counterexample_paths_rendered_spatially():
+    """Failed linearization orders render SPATIALLY over the time grid
+    (knossos/linear/report.clj:385-647): each path is an arrow chain
+    hopping between the ops' bars, every hop labeled with the model
+    state it produced, the inconsistent hop red — not just text
+    chips."""
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", None), ok(1, "read", 2)]
+    a = linear.analysis(M.register(), h, backend="device")
+    assert a.valid is False
+    assert a.info.get("paths"), a.info
+    svg = linear_svg.render_analysis(h, a)
+    # spatial chain: anchored circles on the grid + the overlay note
+    assert "drawn over the grid" in svg
+    assert svg.count("<circle") >= 1
+    # the inconsistent hop is drawn in the failure color
+    assert "#c0392b" in svg
