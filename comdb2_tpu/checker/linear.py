@@ -49,6 +49,11 @@ class Analysis:
         return m
 
 
+# histories with more padded segments than this always run the chunked
+# engine (XLA compile time scales with scan length; see _analyze_device)
+CHUNKED_S_THRESHOLD = 4096
+
+
 def analysis(model: Model,
              history: Union[Sequence[Op], PackedHistory],
              backend: str = "auto",
@@ -180,56 +185,81 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     # engine degrades to big-only when F is too small for the tier)
     info.pop("engine", None)
     Fs = 32
-    for F in capacities:
-        if progress is None:
+    # Large histories ALWAYS run chunked, progress callback or not:
+    # XLA compile time scales with the scan length, and a monolithic
+    # 65536-segment program takes >10 min to compile per ladder level
+    # where the 2048-chunk program compiles in ~35 s and streams the
+    # remaining chunks in seconds (measured on a 117k-event P=18
+    # history). Small histories keep the single-dispatch form — per-
+    # chunk dispatch overhead would dominate them on the tunnel.
+    chunked = (progress is not None
+               or segs.ok_proc.shape[0] > CHUNKED_S_THRESHOLD)
+    if not chunked:
+        for F in capacities:
             status, fail_seg, n_final = LJ.check_device_seg2(
                 succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
                 segs.depth, F=F, Fs=Fs, P=P2, **sizes)
-        else:
-            # chunked: report between device calls at ~interval cadence
-            S = segs.ok_proc.shape[0]
-            chunk = max(_next_pow2(min(S, 2048)), 64)
-            carry = LJ.init_seg_carry(F, P2)
-            t_run = time.monotonic()
-            last = t_run
-            done = 0
-            visited = 0
-            while done < S:
-                end = min(done + chunk, S)
-                pad = chunk - (end - done)
-                ip = np.pad(segs.inv_proc[done:end],
-                            ((0, pad), (0, 0)), constant_values=-1)
-                it = np.pad(segs.inv_tr[done:end], ((0, pad), (0, 0)))
-                op_ = np.pad(segs.ok_proc[done:end], (0, pad),
-                             constant_values=-1)
-                dp = np.pad(segs.depth[done:end], (0, pad))
-                carry = LJ.check_device_seg2_chunk(
-                    succ, ip, it, op_, dp, done, carry, F=F, Fs=Fs,
-                    P=P2, **sizes)
-                visited += int(carry[3]) * (end - done)
-                done = end
-                if int(carry[4]) != LJ.VALID:
-                    break
-                now = time.monotonic()
-                if now - last >= progress_interval_s:
-                    # pending counts from the carry: telemetry parity
-                    # with the reference's visited/s + estimated-cost
-                    # reporters (core.clj:442-460, config.clj:374-393).
-                    # Bucketed on device so only P+1 ints ride the
-                    # (slow) tunnel per tick, never the (F, P) frontier
-                    hist = np.asarray(LJ.pending_histogram(
-                        carry[1], carry[2], P=P2))
-                    el = max(now - t_run, 1e-9)
-                    progress(min(done, s_real), s_real, int(carry[3]),
-                             {"visited_per_s": visited / el,
-                              "segs_per_s": done / el,
-                              "est_cost": LJ.estimated_cost_hist(hist)})
-                    last = now
-            status, fail_seg, n_final = carry[4], carry[5], carry[3]
-        status = int(status)
+            status = int(status)
+            info["frontier_capacity"] = F
+            if status != LJ.UNKNOWN:
+                break
+    else:
+        # chunked, with IN-PLACE capacity escalation: an overflow
+        # re-runs only the overflowing chunk with the boundary carry
+        # widened to the next ladder level — a restart would repay
+        # every already-checked chunk per level (on a 117k-event
+        # history each full pass is ~40 s even warm)
+        S = segs.ok_proc.shape[0]
+        chunk = max(_next_pow2(min(S, 2048)), 64)
+        cap_ix = 0
+        F = capacities[cap_ix]
+        carry = LJ.init_seg_carry(F, P2)
+        t_run = time.monotonic()
+        last = t_run
+        done = 0
+        visited = 0
+        while done < S:
+            end = min(done + chunk, S)
+            pad = chunk - (end - done)
+            ip = np.pad(segs.inv_proc[done:end],
+                        ((0, pad), (0, 0)), constant_values=-1)
+            it = np.pad(segs.inv_tr[done:end], ((0, pad), (0, 0)))
+            op_ = np.pad(segs.ok_proc[done:end], (0, pad),
+                         constant_values=-1)
+            dp = np.pad(segs.depth[done:end], (0, pad))
+            new_carry = LJ.check_device_seg2_chunk(
+                succ, ip, it, op_, dp, done, carry, F=F, Fs=Fs,
+                P=P2, **sizes)
+            st = int(new_carry[4])
+            if st == LJ.UNKNOWN and cap_ix + 1 < len(capacities):
+                cap_ix += 1
+                F = capacities[cap_ix]
+                carry = LJ.expand_seg_carry(carry, F)
+                continue            # same chunk, wider frontier
+            carry = new_carry
+            visited += int(carry[3]) * (end - done)
+            done = end
+            if st != LJ.VALID:
+                break
+            now = time.monotonic()
+            if progress is not None and \
+                    now - last >= progress_interval_s:
+                # pending counts from the carry: telemetry parity
+                # with the reference's visited/s + estimated-cost
+                # reporters (core.clj:442-460, config.clj:374-393).
+                # Bucketed on device so only P+1 ints ride the
+                # (slow) tunnel per tick, never the (F, P) frontier
+                hist = np.asarray(LJ.pending_histogram(
+                    carry[1], carry[2], P=P2))
+                el = max(now - t_run, 1e-9)
+                progress(min(done, s_real), s_real, int(carry[3]),
+                         {"visited_per_s": visited / el,
+                          "segs_per_s": done / el,
+                          "est_cost": LJ.estimated_cost_hist(hist)})
+                last = now
+        status, fail_seg, n_final = (int(carry[4]), carry[5],
+                                     carry[3])
         info["frontier_capacity"] = F
-        if status != LJ.UNKNOWN:
-            break
     info["time_s"] = time.monotonic() - t0
     return _device_verdict(mm, packed, segs, status, fail_seg, n_final,
                            info)

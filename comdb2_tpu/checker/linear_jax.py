@@ -527,6 +527,23 @@ def init_seg_carry(F: int, P: int):
             jnp.int32(-1))
 
 
+def expand_seg_carry(carry, F_new: int):
+    """Widen a GOOD chunk-boundary carry to a larger frontier capacity:
+    in-place escalation resumes the search at the overflowing chunk
+    instead of restarting the whole history at the next ladder level
+    (each restart repays every chunk already checked). Status/fail are
+    reset — the carry must come from before the overflow."""
+    states, slots, valid, count, _status, _fail = carry
+    pad = F_new - states.shape[0]
+    if pad < 0:
+        raise ValueError("carry wider than target capacity")
+    states = jnp.pad(states, (0, pad))
+    slots = jnp.pad(slots, ((0, pad), (0, 0)), constant_values=IDLE)
+    valid = jnp.pad(valid, (0, pad))
+    return (states, slots, valid, count, jnp.int32(VALID),
+            jnp.int32(-1))
+
+
 @functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
                                              "n_transitions"))
 def check_device_seg_chunk(succ, inv_proc, inv_tr, ok_proc, depth,

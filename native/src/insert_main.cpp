@@ -38,6 +38,9 @@ struct Opts {
     long select_records = 0;
     int select_bug = 0;         /* seed the range with a record missing */
     int test_dup = 0;           /* blkseq-dup (insert.c -x) */
+    const char *target = nullptr;   /* "host:port,..." = live cluster
+                                     * through the HA TCP backend
+                                     * (in-memory backend otherwise) */
 };
 
 void usage(const char *argv0) {
@@ -46,6 +49,8 @@ void usage(const char *argv0) {
             "  -T n     worker threads (default 5)\n"
             "  -i n     total inserts (default 1000)\n"
             "  -j file  EDN history output\n"
+            "  -d t     SUT target \"host:port,...\" (live cluster "
+            "through the HA TCP client; in-memory otherwise)\n"
             "  -F       flaky SUT backend\n"
             "  -B       buggy SUT backend (MUST be caught: exit 1)\n"
             "  -S n     select-stress: seed [0,n) and verify the range "
@@ -86,11 +91,12 @@ long select_stress_check(sut_handle *h, long S) {
 int main(int argc, char **argv) {
     Opts opt;
     int c;
-    while ((c = getopt(argc, argv, "T:i:j:FBS:Zxs:h")) != -1) {
+    while ((c = getopt(argc, argv, "T:i:j:d:FBS:Zxs:h")) != -1) {
         switch (c) {
         case 'T': opt.nthreads = atoi(optarg); break;
         case 'i': opt.n_inserts = atol(optarg); break;
         case 'j': opt.edn_path = optarg; break;
+        case 'd': opt.target = optarg; break;
         case 'F': opt.sut_flags |= SUT_F_FLAKY; break;
         case 'B': opt.sut_flags |= SUT_F_BUGGY; break;
         case 'S': opt.select_records = atol(optarg); break;
@@ -118,17 +124,31 @@ int main(int argc, char **argv) {
      * insert.c -Y/-B prepare, done inline since the in-memory backend
      * is process-local) */
     if (S > 0) {
-        sut_handle *h = sut_open(nullptr, SUT_F_NONE, opt.seed);
+        sut_handle *h = sut_open(opt.target, SUT_F_NONE, opt.seed);
         for (long v = 0; v < S; v++) {
             if (opt.select_bug && v == S / 2) continue;
-            sut_set_add(h, v);
+            /* against a live cluster a seed add can land in a fault
+             * window — a silently dropped seed would turn every later
+             * stress check into a false consistency violation */
+            int rc = SUT_FAIL;
+            for (int attempt = 0; attempt < 40; attempt++) {
+                rc = sut_set_add(h, v);
+                if (rc == SUT_OK) break;
+                struct timespec ts = {0, 250 * 1000 * 1000};
+                nanosleep(&ts, nullptr);
+            }
+            if (rc != SUT_OK) {
+                fprintf(stderr, "seeding value %ld failed\n", v);
+                return 2;
+            }
         }
         sut_close(h);
     }
 
     auto worker = [&](int tid) {
         sut_handle *h =
-            sut_open(nullptr, opt.sut_flags, opt.seed * 131u + (unsigned)tid);
+            sut_open(opt.target, opt.sut_flags,
+                     opt.seed * 131u + (unsigned)tid);
         char val[64];
         int process = tid;
         for (;;) {
@@ -175,14 +195,25 @@ int main(int argc, char **argv) {
     for (auto &t : threads) t.join();
 
     /* final read + classification (insert.c check(), :355-437) */
-    sut_handle *h = sut_open(nullptr, SUT_F_NONE, opt.seed);
+    sut_handle *h = sut_open(opt.target, SUT_F_NONE, opt.seed);
     long long *vals = nullptr;
     size_t n = 0;
     /* the reader needs a process id outside every worker's retirement
      * chain (tid + k*nthreads covers all non-negative ids) */
     const int reader = -1;
     edn_emit(edn, "invoke", "read", "nil", reader, ct_timeus());
-    int rc = sut_set_read(h, &vals, &n);
+    /* the final committed read must survive a fault window still in
+     * flight (leaderless gap, partition healing) — the reference
+     * heals and gates on coherency before its check; against a live
+     * cluster we retry instead of failing the whole run on one
+     * transient window */
+    int rc = SUT_FAIL;
+    for (int attempt = 0; attempt < 40; attempt++) {
+        rc = sut_set_read(h, &vals, &n);
+        if (rc == SUT_OK) break;
+        struct timespec ts = {0, 250 * 1000 * 1000};
+        nanosleep(&ts, nullptr);
+    }
     if (rc != SUT_OK) {
         fprintf(stderr, "final read failed\n");
         return 2;

@@ -553,7 +553,11 @@ bool read_line(int fd, std::string *out) {
         }
         if (c == '\n') return true;
         out->push_back(c);
-        if (out->size() > 4096) return false;
+        if (out->size() > (32u << 20)) return false;  /* forwarded set
+                                                       * reads can be
+                                                       * large — match
+                                                       * the HA client's
+                                                       * 32 MB buffer */
     }
 }
 
@@ -1111,15 +1115,41 @@ std::string handle(const std::string &line, bool forwarded) {
         return "UNKNOWN";
     }
     if (cmd == 'S') {
-        std::lock_guard<std::mutex> g(n.mu);
-        /* durable mode: only the committed prefix — an uncommitted
-         * set element could be truncated after failover, and a reader
-         * that saw it would report a "flickering" element */
-        const std::vector<long long> &vals =
-            n.durable ? n.committed.set_vals : n.spec.set_vals;
-        std::string out = "V";
-        for (long long v : vals) out += " " + std::to_string(v);
-        return out;
+        /* same routing as R (the REQUEST_DURABLE_LSN_FROM_MASTER
+         * shape): durable-mode set reads go to the lease-holding
+         * leader and serve the COMMITTED prefix — a replica's
+         * committed set lags by a heartbeat and a fresh session
+         * reading it would see acked adds as lost; an uncommitted
+         * element could be truncated after failover and flicker */
+        bool am_leader, speculative;
+        {
+            std::lock_guard<std::mutex> g(n.mu);
+            am_leader = n.role == PRIMARY;
+            if (!n.durable) {
+                std::string out = "V";
+                for (long long v : n.spec.set_vals)
+                    out += " " + std::to_string(v);
+                return out;
+            }
+            speculative = am_leader && n.split_brain &&
+                          !n.lease_fresh_locked();
+            if (speculative ||
+                (am_leader && n.lease_fresh_locked() &&
+                 n.durable_lsn >= n.term_start_lsn)) {
+                const std::vector<long long> &vals =
+                    speculative ? n.spec.set_vals
+                                : n.committed.set_vals;
+                std::string out = "V";
+                for (long long v : vals)
+                    out += " " + std::to_string(v);
+                return out;
+            }
+        }
+        if (!am_leader && !forwarded)
+            return forward_to_leader("S");
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(n.timeout_ms));
+        return "UNKNOWN";
     }
     if (cmd == 'T' && line.size() >= 2) {
         /* transaction verbs (the begin/op/commit surface the sut.h

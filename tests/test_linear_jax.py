@@ -365,3 +365,57 @@ def test_dedup_survives_sentinel_collisions():
     status, fail_at, n = LJ.check_device(LJ.pad_succ(mm.succ), *stream,
                                          F=F, P=4)
     assert int(status) == LJ.VALID
+
+
+def test_chunked_inplace_escalation_matches_monolithic(monkeypatch):
+    """Large histories run the chunked engine with IN-PLACE capacity
+    escalation: an overflow widens the boundary carry and re-runs only
+    the overflowing chunk (a restart would repay every checked chunk
+    per ladder level). Forced on via the threshold; verdicts must
+    match the monolithic ladder on valid, invalid, and genuinely
+    overflowing histories."""
+    import random
+
+    from comdb2_tpu.checker import linear
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.op import Op
+    from comdb2_tpu.ops.synth import register_history
+
+    rng = random.Random(8)
+    valid_h = register_history(rng, n_procs=4, n_events=600, values=4,
+                               p_info=0.05)
+    invalid_h = list(valid_h)
+    for i in range(len(invalid_h) - 1, -1, -1):
+        if invalid_h[i].type == "ok" and invalid_h[i].f == "read":
+            invalid_h[i] = invalid_h[i].with_(value=99)
+            break
+    # frontier needs > 8 configs early on (3 pending writers), so the
+    # first capacity level must overflow and escalate mid-history
+    caps = (8, 256)
+
+    orig_threshold = linear.CHUNKED_S_THRESHOLD
+    for h in (valid_h, invalid_h):
+        mono = linear.analysis(cas_register(), h, backend="device",
+                               capacities=caps)
+        monkeypatch.setattr(linear, "CHUNKED_S_THRESHOLD", 4)
+        chunked = linear.analysis(cas_register(), h, backend="device",
+                                  capacities=caps)
+        monkeypatch.setattr(linear, "CHUNKED_S_THRESHOLD",
+                            orig_threshold)
+        assert chunked.valid == mono.valid, (chunked.info, mono.info)
+        if not chunked.valid:
+            assert chunked.op_index == mono.op_index
+
+    # exhausted ladder still yields UNKNOWN: many concurrent pending
+    # writers blow past the last capacity
+    hard = []
+    for p in range(10):
+        hard.append(Op(process=p, type="invoke", f="write", value=p,
+                       time=p))
+    hard.append(Op(process=11, type="invoke", f="read", value=None,
+                   time=20))
+    hard.append(Op(process=11, type="ok", f="read", value=3, time=21))
+    monkeypatch.setattr(linear, "CHUNKED_S_THRESHOLD", 4)
+    a = linear.analysis(cas_register(), hard, backend="device",
+                        capacities=(8, 16))
+    assert a.valid == "unknown", a.info
