@@ -86,6 +86,20 @@ long select_stress_check(sut_handle *h, long S) {
     return errors;
 }
 
+/* bounded retry for SUT calls that can land in a live cluster's fault
+ * window (leaderless gap, partition healing): ~10 s total budget */
+template <typename Fn>
+int retry_sut(Fn fn) {
+    int rc = SUT_FAIL;
+    for (int attempt = 0; attempt < 40; attempt++) {
+        rc = fn();
+        if (rc == SUT_OK) break;
+        struct timespec ts = {0, 250 * 1000 * 1000};
+        nanosleep(&ts, nullptr);
+    }
+    return rc;
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
@@ -130,14 +144,8 @@ int main(int argc, char **argv) {
             /* against a live cluster a seed add can land in a fault
              * window — a silently dropped seed would turn every later
              * stress check into a false consistency violation */
-            int rc = SUT_FAIL;
-            for (int attempt = 0; attempt < 40; attempt++) {
-                rc = sut_set_add(h, v);
-                if (rc == SUT_OK) break;
-                struct timespec ts = {0, 250 * 1000 * 1000};
-                nanosleep(&ts, nullptr);
-            }
-            if (rc != SUT_OK) {
+            if (retry_sut([&] { return sut_set_add(h, v); })
+                != SUT_OK) {
                 fprintf(stderr, "seeding value %ld failed\n", v);
                 return 2;
             }
@@ -207,14 +215,8 @@ int main(int argc, char **argv) {
      * heals and gates on coherency before its check; against a live
      * cluster we retry instead of failing the whole run on one
      * transient window */
-    int rc = SUT_FAIL;
-    for (int attempt = 0; attempt < 40; attempt++) {
-        rc = sut_set_read(h, &vals, &n);
-        if (rc == SUT_OK) break;
-        struct timespec ts = {0, 250 * 1000 * 1000};
-        nanosleep(&ts, nullptr);
-    }
-    if (rc != SUT_OK) {
+    if (retry_sut([&] { return sut_set_read(h, &vals, &n); })
+        != SUT_OK) {
         fprintf(stderr, "final read failed\n");
         return 2;
     }
