@@ -1125,11 +1125,22 @@ std::string handle(const std::string &line, bool forwarded) {
          * With group commit the syncer fsyncs outside the lock — wait
          * briefly for it to cover this append so the sender doesn't
          * spin re-offering (one fsync covers everything buffered
-         * meanwhile) */
+         * meanwhile). The wait must stay BELOW the sender's 200 ms
+         * socket timeout or a slow fsync turns into a reconnect storm
+         * with every reply discarded. */
         if (n.syncing() && n.synced_lsn < n.applied_lsn)
-            n.cv.wait_for(g, std::chrono::milliseconds(1000), [&] {
+            n.cv.wait_for(g, std::chrono::milliseconds(150), [&] {
                 return n.synced_lsn >= n.applied_lsn;
             });
+        if (eterm != n.term || eterm != n.certified_term) {
+            /* the wait dropped the lock: a NEWER leader may have
+             * replicated meanwhile (step_down + truncation + new
+             * certification). An ack computed from that state must
+             * not reach the OLD-term sender — it would count a
+             * replaced entry toward the old leader's durability and
+             * an acked write could be lost. */
+            return "N " + std::to_string(n.term);
+        }
         n.advance_committed_locked();
         return "A " + std::to_string(n.ack_locked());
     }

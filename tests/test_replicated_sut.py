@@ -688,3 +688,36 @@ def test_bad_lease_clock_fault_serves_stale_read():
          Op(process=3, type="invoke", f="read", value=None, time=6),
          Op(process=3, type="ok", f="read", value=5, time=7)]
     assert analysis(cas_register(), h, backend="host").valid is False
+
+
+def test_five_node_cluster_breaknet_failover(tmp_path):
+    """Reference scale: 5 nodes (m1-m5, comdb2/core.clj:195-208) with
+    the breaknet partition shape {master, +1} vs the other three
+    (nemesis.c:90-144) — at five nodes that cut denies the master
+    quorum, so the majority side must elect and serve while the
+    minority's writes go indeterminate; the whole history stays
+    linearizable (seed 71)."""
+    ports = _free_ports(5)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=300,
+                          elect_ms=500, lease_ms=300)
+    try:
+        ctl = ClusterControl(ports)
+        t = _cluster_test(
+            tmp_path, ports, "cluster-5node-breaknet",
+            concurrency=7,
+            nemesis=ClusterPartitioner(ctl, rng=random.Random(71)),
+            generator=_nemesis_gen(seed=71, secs=6.0, window=2.0,
+                                   lead=0.4, gap=0.8))
+        result = core.run(t)
+        terms = [n.get("term", 1) for n in ctl.info()
+                 if n["role"] != "down"]
+        ctl.heal()
+        assert result["results"]["valid?"] is True, \
+            ("seed 71", result["results"])
+        assert max(terms) > 1, "breaknet never forced an election"
+        oks = [op for op in result["history"] if op.type == "ok"]
+        assert len(oks) >= 100, len(oks)
+        # converges after heal
+        assert ctl.await_replicated(timeout_s=10.0), ctl.info()
+    finally:
+        _kill(procs)
