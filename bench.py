@@ -30,7 +30,7 @@ BASELINE_OPS_S = N_OPS / 3600.0
 
 B_HISTS = 256        # batch metric: independent histories per launch
 B_EVENTS = 800       # events per batched history (~102k ops total)
-N_RUNS = 5           # timed runs per metric
+N_RUNS = 7           # timed runs per metric (median-of-7 headline)
 
 
 def _spread(n_ops: int, dts) -> dict:
@@ -46,6 +46,21 @@ def _spread(n_ops: int, dts) -> dict:
         "ops_per_s_median": round(statistics.median(per), 1),
         "ops_per_s_max": round(per[-1], 1),
     }
+
+
+def _median(n_ops: int, dts) -> float:
+    """Headline = MEDIAN of the timed runs, not the max: best-of-N
+    flatters the tunnel's variance (round-3 Weak #1)."""
+    import statistics
+
+    return statistics.median(n_ops / dt for dt in dts)
+
+
+# headline medians of previous rounds' artifacts (BENCH_r0*.json);
+# r1/r2 predate the spread fields so they carry the then-reported
+# value (best-of-N — labeled, not silently mixed)
+TREND_50K = {"r1_best": 85226.6, "r2_best": 80267.5,
+             "r3_median": 70559.3}
 
 
 def main() -> None:
@@ -95,7 +110,7 @@ def _bench_batch() -> None:
         t0 = time.perf_counter()
         check_batch(batch, F=256, info=info)
         dts.append(time.perf_counter() - t0)
-    ops_s = n_ops / min(dts)
+    ops_s = _median(n_ops, dts)
     print(json.dumps({
         "metric": "batch_check_ops_per_s_256x",
         "value": round(ops_s, 1),
@@ -158,20 +173,28 @@ def _run_bench() -> None:
 
     status = run()                        # compile + sanity
     assert status == LJ.VALID, f"bench history misjudged: status={status}"
+    # a silent demotion to the XLA engines is a ~6x cliff; on real TPU
+    # hardware that is a kernel regression and must FAIL the bench, not
+    # just flip a field (round-3 Weak #5)
+    if jax.default_backend() not in ("cpu",):
+        assert engine["e"] == "pallas-fused", (
+            f"fused kernel did not serve the bench on "
+            f"{jax.default_backend()}: engine={engine['e']}")
     dts = []
-    for _ in range(N_RUNS):               # best-of-N: tunnel variance
+    for _ in range(N_RUNS):               # spread: tunnel variance
         t0 = time.perf_counter()
         run()
         dts.append(time.perf_counter() - t0)
-    dt = min(dts)
 
-    ops_s = n_ops / dt
+    ops_s = _median(n_ops, dts)
+    trend = dict(TREND_50K, r4_median=round(ops_s, 1))
     print(json.dumps({
         "metric": "linear_check_ops_per_s_50k",
         "value": round(ops_s, 1),
         "unit": "ops/s",
         "vs_baseline": round(ops_s / BASELINE_OPS_S, 2),
         "engine": engine["e"],
+        "trend": trend,
         **_spread(n_ops, dts),
     }))
 

@@ -146,10 +146,11 @@ def _greedy_split(widths):
 def pack_bits(n_states: int, n_transitions: int, P: int):
     """Bit budget for packing one config (state + P slots) into two
     int32 words. Returns (state_bits, slot_bits, fits); fits is False
-    when the greedy per-word split overflows (fall back to full
-    lexsort). Slot values live in [-2, T), stored as slot+2. hi must
-    stay below bit 30: the invalid sentinel is 1<<30 and must sort
-    after every valid key."""
+    when the greedy per-word split overflows (the engines then pack
+    into MORE words — see :class:`PackPlan` — never a lossy key).
+    Slot values live in [-2, T), stored as slot+2. hi must stay below
+    bit 30: the invalid sentinel is 1<<30 and must sort after every
+    valid key."""
     state_bits = max(int(np.ceil(np.log2(max(n_states, 2)))), 1)
     slot_bits = max(int(np.ceil(np.log2(max(n_transitions + 2, 2)))), 1)
     _, hi_bits = _greedy_split([state_bits] + [slot_bits] * P)
@@ -157,37 +158,67 @@ def pack_bits(n_states: int, n_transitions: int, P: int):
     return state_bits, slot_bits, fits
 
 
-def _pack_words(states, slots, state_bits: int, slot_bits: int):
-    """Exact (hi, lo) int32 fingerprint of each config row. Fields fill
-    lo from the least-significant end until 31 bits are used, then hi
-    (each word stays < 2^30 by the pack_bits budget)."""
-    P = slots.shape[1]
-    fields = [(states, state_bits)] + \
-        [(slots[:, q] + 2, slot_bits) for q in range(P)]
-    lo = jnp.zeros_like(states)
-    lo_bits = 0
-    i = len(fields) - 1
-    while i >= 0 and lo_bits + fields[i][1] <= 31:
-        lo = lo | (fields[i][0] << lo_bits)
-        lo_bits += fields[i][1]
-        i -= 1
-    hi = jnp.zeros_like(states)
-    hi_bits = 0
-    while i >= 0:
-        hi = hi | (fields[i][0] << hi_bits)
-        hi_bits += fields[i][1]
-        i -= 1
-    return hi, lo
+class PackPlan(NamedTuple):
+    """Exact lossless packing of one config (state + P slots) into
+    ``n_words`` int32 sort keys — the wide-P generalization of the
+    two-word budget (round-3 VERDICT #1: ``ArrayProcesses`` has no
+    width limit, ``knossos/linear/config.clj:157-295``, and the
+    reference CLI defaults to concurrency 30, ``cli.clj:52-91``).
+
+    ``assign[i]`` is the (word, shift) of field i, fields =
+    [state, slot_0, .., slot_{P-1}], filled greedily from the END of
+    the list into word 0 (the least-significant sort key), then word
+    1, ... Words hold <= 31 bits (values stay non-negative int32); the
+    TOP word keeps bits 29/30 free for the okp-order flag and the
+    invalid sentinel. Dedup sorts by all words (top = primary), so
+    equal configs are adjacent — exact for ANY P, at W = ceil(bits/31)
+    sort keys instead of the P+2 full-lexsort passes whose compile
+    explodes at F >= 1024 (CLAUDE.md "STILL OPEN", now closed)."""
+    state_bits: int
+    slot_bits: int
+    P: int
+    assign: tuple          # ((word, shift), ...) per field
+    n_words: int
 
 
-def _dedup_compact(states, slots, valid, F, state_bits=None,
-                   slot_bits=None, okp=None):
+def make_pack_plan(n_states: int, n_transitions: int,
+                   P: int) -> Optional[PackPlan]:
+    """Build the multi-word plan, or None when a single field exceeds
+    29 bits (then only the full row lexsort is exact)."""
+    state_bits = max(int(np.ceil(np.log2(max(n_states, 2)))), 1)
+    slot_bits = max(int(np.ceil(np.log2(max(n_transitions + 2, 2)))), 1)
+    widths = [state_bits] + [slot_bits] * P
+    if max(widths) > 29:
+        return None
+    assign: list = [None] * len(widths)
+    word, used = 0, 0
+    for i in range(len(widths) - 1, -1, -1):
+        if used + widths[i] > 31:
+            word, used = word + 1, 0
+        assign[i] = (word, used)
+        used += widths[i]
+    if used > 29:
+        word += 1              # flags get a fresh top word
+    return PackPlan(state_bits, slot_bits, P, tuple(assign), word + 1)
+
+
+def _pack_plan_words(states, slots, plan: PackPlan):
+    """Pack each config row into ``plan.n_words`` int32 words
+    (word 0 least significant)."""
+    fields = [states] + [slots[:, q] + 2 for q in range(plan.P)]
+    words = [jnp.zeros_like(states) for _ in range(plan.n_words)]
+    for f, (w, sh) in zip(fields, plan.assign):
+        words[w] = words[w] | (f << sh)
+    return words
+
+
+def _dedup_compact(states, slots, valid, F, plan=None, okp=None):
     """Sort rows into an exact order (valid first) so identical configs
     are guaranteed adjacent; drop duplicates.
     Returns (states[F], slots[F,P], valid[F], n_unique, overflow).
 
-    With a bit budget (state_bits/slot_bits), rows pack losslessly into
-    two int32 words — a 2-key sort instead of P+2 stable sort passes;
+    With a :class:`PackPlan`, rows pack losslessly into ``plan.n_words``
+    int32 words — a W-key sort instead of P+2 stable sort passes;
     otherwise falls back to the full lexicographic sort. Both are exact:
     hash-fingerprint ordering is NOT sound here (colliding non-identical
     rows can interleave between equal rows and break adjacency).
@@ -202,19 +233,23 @@ def _dedup_compact(states, slots, valid, F, state_bits=None,
         not_ret = (jnp.take_along_axis(
             slots, jnp.full((slots.shape[0], 1), okp, jnp.int32),
             axis=1)[:, 0] != LIN).astype(jnp.int32)
-    if state_bits is not None:
-        hi, lo = _pack_words(states, slots, state_bits, slot_bits)
+    if plan is not None:
+        words = _pack_plan_words(states, slots, plan)
+        top = words[-1]
         if okp is not None:
-            # hi stays < 2^30 by the pack_bits budget; bit 29 is free
-            # and below the invalid sentinel (1 << 30)
-            hi = hi | (not_ret << 29)
-        hi = jnp.where(valid, hi, jnp.int32(1) << 30)  # invalid last
-        order = jnp.lexsort((lo, hi))
-        h, l = hi[order], lo[order]
+            # the top word stays < 2^29 by the plan budget; bit 29 is
+            # free and below the invalid sentinel (1 << 30)
+            top = top | (not_ret << 29)
+        top = jnp.where(valid, top, jnp.int32(1) << 30)  # invalid last
+        words[-1] = top
+        order = jnp.lexsort(tuple(words))
+        ws = [w[order] for w in words]
         va = valid[order]
         pad = jnp.zeros(1, bool)
-        same = jnp.concatenate([pad, (h[1:] == h[:-1])
-                                & (l[1:] == l[:-1]) & va[:-1]])
+        eq = ws[0][1:] == ws[0][:-1]
+        for w in ws[1:]:
+            eq = eq & (w[1:] == w[:-1])
+        same = jnp.concatenate([pad, eq & va[:-1]])
     else:
         # lexsort: last key is primary — valid rows first, full row order
         keys = tuple(slots[:, q] for q in range(P - 1, -1, -1)) \
@@ -251,7 +286,7 @@ def _expand(succ, states, slots, valid):
     return s2.reshape(F * P), cand_slots.reshape(F * P, P), cand_valid
 
 
-def _closure(succ, states, slots, valid, n_valid, F, P, bits,
+def _closure(succ, states, slots, valid, n_valid, F, P, plan,
              max_iter=None, okp=None):
     """Fixed point of single-call linearization with dedup.
     ``max_iter`` bounds iterations exactly (= pending-call count, the
@@ -272,7 +307,7 @@ def _closure(succ, states, slots, valid, n_valid, F, P, bits,
         all_sl = jnp.concatenate([sl, c_sl])
         all_va = jnp.concatenate([va, c_va])
         st2, sl2, va2, n2, ovf = _dedup_compact(all_st, all_sl, all_va,
-                                                F, *bits, okp=okp)
+                                                F, plan=plan, okp=okp)
         return st2, sl2, va2, n2, n2 > n, ovf, it + 1
 
     init = body((states, slots, valid, n_valid,
@@ -311,7 +346,7 @@ def _make_step(succ, F, P, bits):
 
 
 def _check_impl(succ, kind, proc, tr, F: int, P: int,
-                bits=(None, None)):
+                bits=None):
     n_ops = kind.shape[0]
     states = jnp.zeros(F, jnp.int32)
     slots = jnp.full((F, P), IDLE, jnp.int32)
@@ -326,11 +361,12 @@ def _check_impl(succ, kind, proc, tr, F: int, P: int,
 
 
 def _bits_for(n_states, n_transitions, P):
-    """Static pack budget, or (None, None) when packing doesn't fit."""
+    """Static :class:`PackPlan` for the multi-word packed dedup, or
+    None (→ full row lexsort) when the true memo sizes are unknown or
+    a single field won't fit a word."""
     if n_states is None or n_transitions is None:
-        return (None, None)
-    sb, tb, fits = pack_bits(n_states, n_transitions, P)
-    return (sb, tb) if fits else (None, None)
+        return None
+    return make_pack_plan(n_states, n_transitions, P)
 
 
 @functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
@@ -342,8 +378,8 @@ def check_device(succ, kind, proc, tr, *, F: int, P: int,
     Returns ``(status, fail_index, n_final)`` — status is VALID/INVALID/
     UNKNOWN; fail_index is the history index of the op at which the
     frontier died (or overflowed). Passing the true (unpadded)
-    ``n_states``/``n_transitions`` enables the packed int32-pair dedup
-    fast path when the config fits 61 bits."""
+    ``n_states``/``n_transitions`` enables the multi-word packed dedup
+    (see :class:`PackPlan`; any P whose fields fit 29 bits)."""
     bits = _bits_for(n_states, n_transitions, P)
     return _check_impl(succ, kind, proc, tr, F, P, bits)
 
@@ -495,7 +531,7 @@ def _make_seg_step(succ, F, P, K, bits, Fs=None):
 
 
 def _check_impl_seg(succ, inv_proc, inv_tr, ok_proc, depth, F: int,
-                    P: int, bits=(None, None)):
+                    P: int, bits=None):
     S, K = inv_proc.shape
     carry = init_seg_carry(F, P)
     segs = (inv_proc, inv_tr, ok_proc,
@@ -946,10 +982,6 @@ def _k_dedup(hi, lo, valid, inv_hi, inv_lo, B, F, single_word: bool):
         # per-block bitonic sort in VMEM; validity rides in the keys
         # (sentinels sort to each block's tail), so sorting values
         # directly replaces the argsort+gather pair
-        from . import pallas_sort as PS
-
-        from . import pallas_sort as PS
-
         # the per-block sort needs batch-contiguous rows; the concat
         # layout interleaves batches (frontier + P candidate chunks,
         # each F-blocked), so gather into (B, R) blocks first

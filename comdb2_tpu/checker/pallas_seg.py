@@ -54,6 +54,9 @@ ROWS, LANES = 8, 128
 N = ROWS * LANES          # flat sort width
 F = LANES                 # frontier capacity (row 0)
 CHUNK = 1024              # segments per kernel call (SMEM-bounded)
+CHUNK_INTERPRET = 16      # interpret mode unrolls the grid at trace
+                          # time — a 1024-step chunk would trace 1024
+                          # kernel bodies
 MAX_STREAM_B = 2048       # histories per streamed call (VMEM-bounded:
                           # two (B,128) int32 result blocks = 2 MB)
 
@@ -65,6 +68,35 @@ VALID, INVALID, UNKNOWN = 0, 1, 2
 
 
 MAX_TABLE = 4 * N          # successor-table entries the kernel serves
+
+import os as _os
+
+# Pallas interpret mode: runs the kernel as plain XLA ops on ANY
+# backend — the only way to execute the kernel's exact semantics on
+# the CPU test mesh (Mosaic is TPU-only; round-3 VERDICT #3: the
+# production kernel was never validated on a sharded mesh anywhere
+# but single-chip TPU). Enabled explicitly (use_interpret) or via
+# COMDB2_TPU_PALLAS_INTERPRET=1 — NOT auto-enabled: per-spec
+# interpret compiles cost ~40 s each on CPU, which would swamp the
+# test suite.
+_INTERPRET = _os.environ.get("COMDB2_TPU_PALLAS_INTERPRET") == "1"
+
+
+def interpret_active() -> bool:
+    return _INTERPRET
+
+
+def use_interpret(on: bool = True) -> None:
+    """Toggle interpret mode; clears the compiled-call and
+    availability caches (specs differ: interpret chunks are short)."""
+    global _INTERPRET
+    if _INTERPRET == on:
+        return
+    _INTERPRET = on
+    _chunk_call.cache_clear()
+    _chunk_jit.cache_clear()
+    _scan_fn.cache_clear()
+    available.cache_clear()
 
 
 class SegKernelSpec(NamedTuple):
@@ -113,6 +145,8 @@ def spec_for(n_states: int, n_transitions: int, P: int,
     # ~56KB (measured limit ~60KB on v5e), in multiples of 128
     width = 2 + 2 * K
     chunk = min(CHUNK, (14336 // width) // 128 * 128)
+    if _INTERPRET:
+        chunk = CHUNK_INTERPRET
     return SegKernelSpec(P, K, slot_bits, state_bits,
                          tuple(pos[:P]), pos[P],
                          table_rows, chunk, table_rows_pad)
@@ -627,6 +661,7 @@ def _chunk_call(spec: SegKernelSpec, b_pad: int = 8):
                        jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
                        jax.ShapeDtypeStruct((1, LANES), jnp.int32),
                        jax.ShapeDtypeStruct((b_pad, LANES), jnp.int32)],
+            interpret=_INTERPRET,
         )(seg, off, hi, lo, stat, res, table)
 
     return call
@@ -980,6 +1015,11 @@ def available() -> bool:
                 "fused Pallas kernel unavailable (probe returned %r) — "
                 "falling back to the XLA engines (~6x slower)", r)
             return False
+        if _INTERPRET:
+            logger.warning(
+                "fused Pallas kernel executing in interpret mode "
+                "(exact kernel semantics as plain XLA ops — for "
+                "non-TPU validation, not performance)")
         return True
     except Exception as e:
         logger.warning(

@@ -30,14 +30,28 @@ class _Proc:
 
 def register_history(rng: random.Random, n_procs: int = 3, n_events: int = 12,
                      values: int = 3, fs=("read", "write", "cas"),
-                     p_info: float = 0.05) -> List[O.Op]:
-    """A linearizable cas-register history with ~``n_events`` total ops."""
+                     p_info: float = 0.05,
+                     max_pending: Optional[int] = None) -> List[O.Op]:
+    """A linearizable cas-register history with ~``n_events`` total ops.
+
+    ``max_pending`` caps how many ops are in flight at once without
+    narrowing the process table — wide-concurrency tests (the
+    reference CLI default is 30 threads, ``cli.clj:52-91``) need wide
+    slot tensors, but an op mix where half of 30 threads sit pending
+    at every instant is a frontier the *reference* can't search either;
+    real harness runs complete ops in milliseconds against a seconds-
+    scale stagger, so in-flight stays far below thread count."""
     state: Optional[int] = None
     procs = [_Proc(i) for i in range(n_procs)]
     next_pid = n_procs
     h: List[O.Op] = []
     while len(h) < n_events:
-        pr = rng.choice(procs)
+        pool = procs
+        if max_pending is not None:
+            pending = [p for p in procs if p.f is not None]
+            if len(pending) >= max_pending:
+                pool = pending
+        pr = rng.choice(pool)
         if pr.f is None:
             pr.f = rng.choice(fs)
             pr.applied = False

@@ -612,6 +612,7 @@ void sender_thread(int peer) {
     for (;;) {
         char buf[192];
         std::string msg;
+        long long t_sent = 0;
         {
             std::unique_lock<std::mutex> lk(n.mu);
             n.cv.wait_for(lk, std::chrono::milliseconds(n.hb_ms), [&] {
@@ -637,6 +638,13 @@ void sender_thread(int peer) {
                 msg = buf;
                 last_hb_sent = mono_ms();
             }
+            /* lease freshness is measured from when the request was
+             * SENT, not when the reply arrived: the receiver's E
+             * handler can sit up to 150 ms in its fsync wait, and
+             * dating the ack at receipt would stretch the effective
+             * lease window past lease_ms by that skew (round-3
+             * ADVICE) */
+            t_sent = n.lease_now_locked();
         }
         if (msg.empty()) continue;
         if (fd < 0) fd = dial(n.ports[peer], 200);
@@ -655,7 +663,7 @@ void sender_thread(int peer) {
         long long x = 0;
         if (sscanf(reply.c_str(), "A %lld", &x) == 1) {
             std::lock_guard<std::mutex> g(n.mu);
-            n.last_ack[peer] = n.lease_now_locked();
+            if (t_sent > n.last_ack[peer]) n.last_ack[peer] = t_sent;
             if (x > n.acked_upto[peer]) {
                 n.acked_upto[peer] = x;
                 n.recompute_durable_locked();
@@ -956,11 +964,13 @@ std::string forward_to_leader(const std::string &cmd) {
             std::chrono::milliseconds(n.timeout_ms));
         return "UNKNOWN";
     }
-    char buf[192];
-    snprintf(buf, sizeof buf, "F %d %s", n.id, cmd.c_str());
+    /* std::string, not a fixed buffer: a truncated command applied on
+     * the leader with an OK reply would be a silent wrong-value write
+     * (round-3 ADVICE) */
+    std::string fwd = "F " + std::to_string(n.id) + " " + cmd;
     /* the leader's durable wait can take timeout_ms on its own */
     std::string r =
-        peer_request(n.ports[ldr], buf, n.timeout_ms + 500);
+        peer_request(n.ports[ldr], fwd, n.timeout_ms + 500);
     return r.empty() ? "UNKNOWN" : r;
 }
 
@@ -1416,14 +1426,21 @@ void serve_conn(int fd) {
         close(fd);
         return;
     }
-    char line[512];
-    while (fgets(line, sizeof line, in) != nullptr) {
-        size_t len = strlen(line);
+    /* dynamic line buffer: a replicated 'T' entry's E line grows with
+     * its sub-ops (~5KB+ at the 512-sub-op admission cap). A fixed
+     * fgets buffer would split it, parse the tail as ERR, and wedge
+     * replication forever (round-3 ADVICE) */
+    char *line = nullptr;
+    size_t cap = 0;
+    ssize_t len;
+    while ((len = getline(&line, &cap, in)) != -1) {
+        if (len > 32 * 1024 * 1024) break;  /* same cap as read_line */
         while (len > 0 && (line[len - 1] == '\n' || line[len - 1] == '\r'))
             line[--len] = 0;
-        std::string out = handle(line) + "\n";
+        std::string out = handle(std::string(line, (size_t)len)) + "\n";
         if (!send_all(fd, out)) break;
     }
+    free(line);
     fclose(in);
 }
 

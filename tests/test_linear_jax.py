@@ -244,8 +244,10 @@ def test_pack_words_injective_when_fits():
                 configs.append((st, sl))
         states = jnp.asarray([c[0] for c in configs], jnp.int32)
         slots = jnp.asarray([c[1] for c in configs], jnp.int32)
-        hi, lo = LJ._pack_words(states, slots, sb, tb)
-        pairs = set(zip(np.asarray(hi).tolist(), np.asarray(lo).tolist()))
+        plan = LJ.make_pack_plan(n_states, n_tr, P)
+        assert plan is not None and plan.n_words <= 2
+        words = LJ._pack_plan_words(states, slots, plan)
+        pairs = set(zip(*(np.asarray(w).tolist() for w in words)))
         assert len(pairs) == len(configs), (n_states, n_tr, P)
 
 
@@ -419,3 +421,70 @@ def test_chunked_inplace_escalation_matches_monolithic(monkeypatch):
     a = linear.analysis(cas_register(), hard, backend="device",
                         capacities=(8, 16))
     assert a.valid == "unknown", a.info
+
+
+# --- wide-P multi-word packed dedup (round-3 VERDICT #1) --------------------
+
+def test_make_pack_plan_widths():
+    """W grows with P; the top word leaves bits 29/30 for flags; every
+    field fits its word."""
+    for n_states, n_tr, P in ((6, 28, 18), (6, 28, 24), (6, 28, 32),
+                              (16, 16, 5), (1 << 20, 4, 3)):
+        plan = LJ.make_pack_plan(n_states, n_tr, P)
+        assert plan is not None
+        used = [0] * plan.n_words
+        widths = [plan.state_bits] + [plan.slot_bits] * P
+        for w_, (word, shift) in zip(widths, plan.assign):
+            assert shift + w_ <= 31
+            used[word] = max(used[word], shift + w_)
+        assert used[-1] <= 29          # flag space in the top word
+    # a single field wider than 29 bits can't pack
+    assert LJ.make_pack_plan(1 << 30, 4, 2) is None
+
+
+def test_pack_plan_words_injective():
+    """Distinct configs must map to distinct word tuples at every P the
+    plan accepts — including P far beyond the two-word budget."""
+    import jax.numpy as jnp
+
+    rng = random.Random(11)
+    for n_states, n_tr, P in ((6, 28, 18), (6, 28, 32), (50, 100, 24)):
+        plan = LJ.make_pack_plan(n_states, n_tr, P)
+        assert plan is not None
+        assert not LJ.pack_bits(n_states, n_tr, P)[2]   # 2 words can't
+        seen = set()
+        configs = []
+        for _ in range(300):
+            c = (rng.randrange(n_states),
+                 tuple(rng.randrange(-2, n_tr) for _ in range(P)))
+            if c not in seen:
+                seen.add(c)
+                configs.append(c)
+        states = jnp.asarray([c[0] for c in configs], jnp.int32)
+        slots = jnp.asarray([c[1] for c in configs], jnp.int32)
+        words = LJ._pack_plan_words(states, slots, plan)
+        packed = set(zip(*(np.asarray(w).tolist() for w in words)))
+        assert len(packed) == len(configs)
+
+
+@pytest.mark.parametrize("P", [18, 24, 32])
+def test_wide_p_device_matches_host(P):
+    """Concurrency far beyond the 62-bit key budget: the multi-word
+    packed dedup must agree with the host engine (valid, invalid, and
+    fail index). The reference has no width limit at all
+    (knossos/linear/config.clj:157-295; CLI default concurrency 30,
+    cli.clj:52-91)."""
+    model = M.cas_register()
+    rng = random.Random(4200 + P)
+    h = histgen.register_history(rng, n_procs=P, n_events=140,
+                                 values=4, p_info=0.0, max_pending=6)
+    for variant in (h, histgen.mutate(rng, h)):
+        packed = pack_history(variant)
+        mm = make_memo(model, packed)
+        r = linear_host.check(mm, packed, max_configs=1 << 20)
+        a = analysis(model, packed, backend="device",
+                     capacities=(512, 2048))
+        assert a.info.get("backend") == "device"
+        assert a.valid == r.valid, (P, a.valid, r.valid)
+        if r.valid is False:
+            assert a.op_index == r.op_index

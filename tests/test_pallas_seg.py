@@ -156,3 +156,84 @@ def test_check_device_pallas_none_when_unfit():
     r = PS.check_device_pallas(mm.succ, segs, n_states=256,
                                n_transitions=64, P=2)
     assert r is None                        # table too large: no fit
+
+
+# --- interpret mode: the PRODUCTION kernel's semantics on CPU ---------------
+#
+# Mosaic is TPU-only, but Pallas interpret mode executes the kernel's
+# exact traced body as plain XLA ops — so the CPU suite can assert the
+# kernel agrees bit-for-bit with the XLA engines, including on the
+# sharded stream path (round-3 VERDICT #3: before this, the kernel's
+# semantics ran nowhere but single-chip TPU). One module-scoped history
+# set keeps interpret compiles (~tens of seconds each) to a minimum.
+
+@pytest.fixture()
+def interpret_kernel():
+    PS.use_interpret(True)
+    yield
+    PS.use_interpret(False)
+
+
+def _parity_histories():
+    import random
+
+    import histgen
+
+    rng = random.Random(909)
+    hs = [histgen.register_history(rng, n_procs=4, n_events=40,
+                                   values=3, p_info=0.0)
+          for _ in range(4)]
+    # one invalid variant so the fail path is compared too
+    hs.append(histgen.mutate(rng, hs[0]))
+    return hs
+
+
+def test_interpret_kernel_matches_xla_single(interpret_kernel):
+    from comdb2_tpu.models.memo import memo as make_memo
+
+    assert PS.interpret_active()
+    assert PS.available()
+    for h in _parity_histories():
+        packed = pack_history(h)
+        mm = make_memo(M.cas_register(), packed)
+        segs = LJ.make_segments(packed)
+        P = len(packed.process_table)
+        r = PS.check_device_pallas(mm.succ, segs, n_states=mm.n_states,
+                                   n_transitions=mm.n_transitions, P=P)
+        assert r is not None
+        succ = LJ.pad_succ(mm.succ, 16, 16)
+        st, fs, n = LJ.check_device_seg2(
+            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+            F=PS.F, Fs=32, P=P + (P & 1), n_states=mm.n_states,
+            n_transitions=mm.n_transitions)
+        assert r == (int(st), int(fs), int(n))
+
+
+def test_interpret_kernel_stream_sharded_matches_keys(interpret_kernel):
+    """The sharded stream path (slices spread across the 8-device CPU
+    mesh) through the interpret kernel, vs the keys engine."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from comdb2_tpu.checker.batch import check_batch, pack_batch
+
+    hs = _parity_histories() * 2                # 10 histories
+    batch = pack_batch(hs, M.cas_register())
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("batch",))
+    info_s: dict = {}
+    st_s, fa_s, n_s = check_batch(batch, F=PS.F, mesh=mesh,
+                                  engine="stream", info=info_s)
+    assert info_s["engine"] == "stream-sharded"
+    info_k: dict = {}
+    st_k, fa_k, n_k = check_batch(batch, F=PS.F, mesh=mesh,
+                                  engine="keys", info=info_k)
+    assert info_k["engine"] == "keys-sharded"
+    np.testing.assert_array_equal(st_s, st_k)
+    np.testing.assert_array_equal(fa_s, fa_k)
+    # n is only defined on VALID verdicts (on INVALID the kernel
+    # reports the emptied frontier, the keys engine the pre-failure
+    # count — same contract as UNKNOWN in CLAUDE.md)
+    ok = st_s == LJ.VALID
+    np.testing.assert_array_equal(n_s[ok], n_k[ok])
