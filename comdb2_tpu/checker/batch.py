@@ -85,7 +85,8 @@ def _empty_stream():
 def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
                model: Model,
                max_states: int = 1 << 20,
-               n_pad: int = 0) -> PackedBatch:
+               n_pad: int = 0,
+               build_streams: bool = True) -> PackedBatch:
     """Pack histories for :func:`~.linear_jax.check_device_batch` /
     :func:`~.linear_jax.check_sharded`.
 
@@ -93,6 +94,15 @@ def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
     share a single memoized model; the BFS depth bound is the max
     invocation count over the batch (exact per history — a history can't
     linearize more ops than it invoked; see ``memoize_model``).
+
+    ``build_streams=False`` skips the dense per-op (N, n_pad) stream
+    tensors that only the vmap fallback uses — at pod-scale batches
+    (4096 × 2k ops) they cost hundreds of host MB the
+    stream/keys/flat engines never read. Such a batch checks with
+    ``engine="stream"``/``"keys"``/``"flat"``, and kernel UNKNOWNs
+    still escalate through keys/flat (they re-segment from
+    ``packeds``); only a vmap-path escalation is unavailable (those
+    lanes then stay ``unknown``).
     """
     packeds = [h if isinstance(h, PackedHistory) else pack_history(list(h))
                for h in histories]
@@ -111,8 +121,12 @@ def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
                  for p in packeds), default=0)
     mm = memoize_model(model, union, max_states=max_states, max_depth=n_inv)
 
-    n_pad = max(n_pad, _next_pow2(max((len(p) for p in packeds), default=1)))
     P = max((len(p.process_table) for p in packeds), default=1)
+    if not build_streams:
+        empty = np.zeros((len(packeds), 0), np.int32)
+        return PackedBatch(packeds=packeds, memo=mm, kind=empty,
+                           proc=empty, tr=empty, P=P, remaps=remaps)
+    n_pad = max(n_pad, _next_pow2(max((len(p) for p in packeds), default=1)))
     kinds, procs, trs = [], [], []
     for p, remap in zip(packeds, remaps):
         s = LJ.make_stream(p, n_pad=n_pad)
@@ -249,24 +263,27 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
         if info is not None:
             info["engine"] = name
 
-    def pick_xla_engine():
+    def pick_xla_engine(b=None):
         # under a mesh each device sees B_pad/D histories — the fits
-        # budgets apply to the per-shard batch
-        b_local = B_pad // D if D > 1 else B
-        if LJ.KeyLayout(b_local, sizes["n_states"],
+        # budgets apply to the per-shard batch. ``b`` overrides the
+        # batch size (escalated sub-batches are far smaller than the
+        # full batch, so their budgets fit where the batch's don't)
+        if b is None:
+            b = B_pad // D if D > 1 else B
+        if LJ.KeyLayout(b, sizes["n_states"],
                         sizes["n_transitions"], P).fits:
             return "keys"
-        if LJ.flat_pack_bits(b_local, sizes["n_states"],
+        if LJ.flat_pack_bits(b, sizes["n_states"],
                              sizes["n_transitions"], P)[3]:
             return "flat"
         return "vmap"
 
     def stream_fits():
         # gate on the spec BEFORE the O(total-ops) segment pass so an
-        # ineligible shape doesn't do the host work twice
-        return (P_k <= 7
-                and PSEG.spec_for(sizes["n_states"],
-                                  sizes["n_transitions"], P_k, 8)
+        # ineligible shape doesn't do the host work twice (spec_for
+        # serves P <= 15 since the (16,128)/3-word tier)
+        return (PSEG.spec_for(sizes["n_states"],
+                              sizes["n_transitions"], P_k, 8)
                 is not None and PSEG.available())
 
     if engine == "auto":
@@ -291,6 +308,16 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
             # overflowed it get their requested budget F through the
             # XLA engines instead of surfacing spurious UNKNOWNs
             unk = escalation_indices(status, F, PSEG.F)
+            # the sub-batch is sized by the overflow count, so pick
+            # the escalation engine from THAT size — at pod-scale
+            # batches the full-B budgets never fit while a handful of
+            # overflowed histories easily do
+            sub_b = (-(-int(unk.size) // D) if D > 1
+                     else int(unk.size))
+            esc_engine = pick_xla_engine(max(sub_b, 1))
+            if unk.size and batch.kind.shape[1] == 0 \
+                    and esc_engine == "vmap":
+                unk = np.empty(0, np.int64)   # no streams: stay unknown
             if unk.size:
                 sub = PackedBatch(
                     packeds=[batch.packeds[i] for i in unk],
@@ -300,7 +327,7 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
                     remaps=[batch.remaps[i] for i in unk])
                 sub_info: dict = {}
                 st2, fa2, n2 = check_batch(sub, F=F, mesh=mesh,
-                                           engine=pick_xla_engine(),
+                                           engine=esc_engine,
                                            info=sub_info)
                 status, fail_at, n_final = merge_escalation(
                     status, fail_at, n_final, unk, st2, fa2, n2)
@@ -330,6 +357,10 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
             sb.seg_index[b, fail_seg[b]] if fail_seg[b] >= 0 else -1
             for b in range(B)], np.int64)
         return status, fail_at, np.asarray(n_final)[:B]
+    if batch.kind.shape[1] == 0:
+        raise ValueError(
+            "batch was packed with build_streams=False; the vmap path "
+            "needs the dense step streams")
     note("vmap" if mesh is None else "vmap-sharded")
     if mesh is not None:
         out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc, batch.tr,

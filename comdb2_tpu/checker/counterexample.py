@@ -147,16 +147,16 @@ def _pallas_boundary(mm, segs, P_k: int, sizes):
     can't serve this shape / didn't reproduce the INVALID."""
     from . import pallas_seg as PSEG
 
-    if P_k > 7 or not PSEG.available():
+    if not PSEG.available():
         return None
     r = PSEG.check_device_pallas_chunked(
         mm.succ, segs, P=P_k, return_boundary=True, **sizes)
     if r is None or r[0] != PSEG.INVALID:
         return None
-    status, fail_seg, _n, (hi, lo, done) = r
+    status, fail_seg, _n, (ws, done) = r
     spec = PSEG.spec_for(sizes["n_states"], sizes["n_transitions"],
                          P_k, segs.inv_proc.shape[1])
-    return PSEG.decode_frontier(spec, hi, lo, P_k), done, fail_seg
+    return PSEG.decode_frontier(spec, ws, P_k), done, fail_seg
 
 
 def _op_desc(packed: PackedHistory, q: int, t: int) -> dict:

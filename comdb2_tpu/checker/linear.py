@@ -149,16 +149,20 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     # Pallas kernel per 1024-segment chunk (checker/pallas_seg.py),
     # ~4x the XLA engines on a real TPU. F is fixed at 128 there;
     # overflow (UNKNOWN) falls through to the XLA capacity ladder, any
-    # other unavailability (CPU backend, key budget, table size, P > 7)
-    # falls back silently.
+    # other unavailability (CPU backend, key budget, table size,
+    # P > 15) falls back silently — check_device_pallas* return None
+    # when spec_for rejects the shape.
     from . import pallas_seg as PSEG
 
-    P_k = P2 if P2 <= 7 else P
+    # even-bucket the kernel's slot count only while it stays in the
+    # (8,128) tier; the (16,128) tier keys are wide enough that a pad
+    # slot can cost a whole extra key word
+    P_k = P2 if P2 <= PSEG.ROWS - 1 else P
     r = None
     # available() probes Mosaic support once per process; past that
     # gate, errors are real bugs (or a raising progress callback) and
     # must propagate, not silently rerun on the XLA path
-    if P_k <= 7 and PSEG.available():
+    if P_k <= 2 * PSEG.ROWS - 1 and PSEG.available():
         if progress is None:
             r = PSEG.check_device_pallas(
                 mm.succ, segs, n_states=mm.n_states,

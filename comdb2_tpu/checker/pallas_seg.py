@@ -67,7 +67,10 @@ SENT_LO = np.int32(0)
 VALID, INVALID, UNKNOWN = 0, 1, 2
 
 
-MAX_TABLE = 4 * N          # successor-table entries the kernel serves
+MAX_TABLE = 8 * N          # successor-table entries the kernel serves
+                           # (64 VMEM rows; the gather unrolls per
+                           # row, so big tables pay compile+run cost
+                           # only in specs that need them)
 
 import os as _os
 
@@ -108,23 +111,35 @@ class SegKernelSpec(NamedTuple):
     widths share ONE compiled kernel. Per-shape Mosaic compiles are
     slow and can OOM LLVM (CLAUDE.md); production ``analysis()`` loops
     see many slightly-different shapes (ADVICE r1)."""
-    P: int                 # slot count (<= ROWS - 1)
+    P: int                 # slot count (<= rows - 1)
     K: int                 # max invokes per segment
     slot_bits: int
     state_bits: int
-    # (word, shift) per slot q, and for the state field
+    # (word, shift) per slot q, and for the state field; word 0 is the
+    # LEAST significant sort key, word n_words-1 the most significant
     slot_pos: tuple
     state_pos: tuple
     table_rows: int        # pow2 bucket of ceil(S*T / LANES)
     chunk: int             # segments per kernel call (SMEM-bounded)
     table_rows_pad: int    # table buffer rows (bucketed: 8 or 32)
+    rows: int              # buffer rows: 8 (P<=7) or 16 (P<=15)
+    n_words: int           # int32 key words per config (2 or 3)
 
 
 def spec_for(n_states: int, n_transitions: int, P: int,
              K: int) -> Optional[SegKernelSpec]:
     """Build the static spec, or None when this shape can't run in the
-    fused kernel (caller falls back to the XLA engines)."""
-    if P > ROWS - 1 or K > 8:
+    fused kernel (caller falls back to the XLA engines).
+
+    P <= 7 runs the classic (8,128) one-vreg-per-word geometry; P <= 15
+    a (16,128) buffer (candidate chunks live in rows 1..P) with up to
+    THREE key words — the round-3 VERDICT #2 extension that serves the
+    reference register test's concurrency 10 (comdb2/core.clj:567-613)
+    on the production kernel."""
+    if K > 8:
+        return None
+    rows = ROWS if P <= ROWS - 1 else 2 * ROWS
+    if P > rows - 1:
         return None
     if n_states * n_transitions > MAX_TABLE:
         return None
@@ -133,14 +148,25 @@ def spec_for(n_states: int, n_transitions: int, P: int,
     pos = []
     word, shift = 0, 0
     for width in [slot_bits] * P + [state_bits]:
+        if width > 29:
+            return None
         if shift + width > 31:
             word, shift = word + 1, 0
-        if word > 1 or (word == 1 and shift + width > 30):
-            return None    # hi must stay below the sentinel bit
         pos.append((word, shift))
         shift += width
+    # the most significant word must keep bits 29/30 free (the okp
+    # flag has no kernel analog, but the sentinel 1<<30 must sort
+    # after every valid key); spill to a fresh word when the last
+    # field crosses bit 30
+    n_words = word + 1
+    if shift > 30:
+        n_words += 1
+    if n_words > 3:
+        return None
     table_rows = _next_pow2(-(-(n_states * n_transitions) // LANES))
-    table_rows_pad = ROWS if table_rows <= ROWS else 4 * ROWS
+    table_rows_pad = (ROWS if table_rows <= ROWS
+                      else (4 * ROWS if table_rows <= 4 * ROWS
+                            else 8 * ROWS))
     # SMEM holds the scalar-prefetch stream: keep chunk * width under
     # ~56KB (measured limit ~60KB on v5e), in multiples of 128
     width = 2 + 2 * K
@@ -149,7 +175,8 @@ def spec_for(n_states: int, n_transitions: int, P: int,
         chunk = CHUNK_INTERPRET
     return SegKernelSpec(P, K, slot_bits, state_bits,
                          tuple(pos[:P]), pos[P],
-                         table_rows, chunk, table_rows_pad)
+                         table_rows, chunk, table_rows_pad,
+                         rows, n_words)
 
 
 def pack_table(succ: np.ndarray, rows: int = ROWS) -> np.ndarray:
@@ -161,12 +188,16 @@ def pack_table(succ: np.ndarray, rows: int = ROWS) -> np.ndarray:
 
 
 def initial_frontier(spec: SegKernelSpec):
-    """(hi, lo) (8,128) host arrays: lane 0 of row 0 = the empty config
-    (all slots idle, state 0), everything else sentinel."""
-    hi = np.full((ROWS, LANES), SENT_HI, np.int32)
-    lo = np.full((ROWS, LANES), SENT_LO, np.int32)
-    hi[0, 0], lo[0, 0] = _root_key(spec)
-    return hi, lo
+    """List of ``n_words`` (rows,128) host arrays (least-significant
+    word first): lane 0 of row 0 = the empty config (all slots idle,
+    state 0), everything else sentinel."""
+    ws = [np.full((spec.rows, LANES),
+                  SENT_HI if w == spec.n_words - 1 else SENT_LO,
+                  np.int32)
+          for w in range(spec.n_words)]
+    for w, v in enumerate(_root_key(spec)):
+        ws[w][0, 0] = v
+    return ws
 
 
 def _init_stat() -> np.ndarray:
@@ -181,27 +212,30 @@ def _init_stat() -> np.ndarray:
 
 
 # --- kernel body helpers (traced; all shapes static) ------------------------
+#
+# Keys are lists ``ws`` of int32 word planes, least-significant word
+# FIRST (ws[-1] is the primary sort key and carries the sentinel).
 
-def _iotas():
+def _iotas(rows: int = ROWS):
     import jax.numpy as jnp
     from jax import lax
 
-    row = lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
-    lane = lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1)
+    row = lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    lane = lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
     return row, lane, row * LANES + lane
 
 
-def _fetch(x, j, lane):
-    """Values at flat positions f+j and f-j (circular over the (8,128)
-    row-major order). j is a static power of two."""
+def _fetch(x, j, lane, rows: int = ROWS):
+    """Values at flat positions f+j and f-j (circular over the
+    (rows,128) row-major order). j is a static power of two."""
     import jax.numpy as jnp
     from jax.experimental.pallas import tpu as pltpu
 
     if j % LANES == 0:
         r = j // LANES
-        return (pltpu.roll(x, ROWS - r, 0), pltpu.roll(x, r, 0))
+        return (pltpu.roll(x, rows - r, 0), pltpu.roll(x, r, 0))
     a = pltpu.roll(x, LANES - j, 1)          # (i, l) <- (i, (l+j)%128)
-    b = pltpu.roll(a, ROWS - 1, 0)           # <- (i+1, (l+j)%128)
+    b = pltpu.roll(a, rows - 1, 0)           # <- (i+1, (l+j)%128)
     plus = jnp.where(lane + j < LANES, a, b)
     c = pltpu.roll(x, j, 1)                  # (i, l) <- (i, (l-j)%128)
     d = pltpu.roll(c, 1, 0)                  # <- (i-1, ...)
@@ -209,90 +243,113 @@ def _fetch(x, j, lane):
     return plus, minus
 
 
-def _cmp_exchange(h, l, ph, pl_, take_min):
+def _ws_less(ws, pws):
+    """Lexicographic < of key lists (most significant word last)."""
+    less = None
+    eq = None
+    for w, pw in zip(reversed(ws), reversed(pws)):
+        if less is None:
+            less = w < pw
+            eq = w == pw
+        else:
+            less = less | (eq & (w < pw))
+            eq = eq & (w == pw)
+    return less
+
+
+def _ws_eq(ws, pws):
+    eq = None
+    for w, pw in zip(ws, pws):
+        eq = (w == pw) if eq is None else (eq & (w == pw))
+    return eq
+
+
+def _cmp_exchange(ws, pws, take_min):
     """One bitonic compare-exchange: keep the lexicographic min or max
-    of (h, l) vs the partner (ph, pl_) per lane."""
+    of ``ws`` vs the partner ``pws`` per lane."""
     import jax.numpy as jnp
 
-    mine_less = (h < ph) | ((h == ph) & (l < pl_))
-    min_h = jnp.where(mine_less, h, ph)
-    min_l = jnp.where(mine_less, l, pl_)
-    max_h = jnp.where(mine_less, ph, h)
-    max_l = jnp.where(mine_less, pl_, l)
-    return (jnp.where(take_min, min_h, max_h),
-            jnp.where(take_min, min_l, max_l))
+    mine_less = _ws_less(ws, pws)
+    return [jnp.where(take_min == mine_less, w, pw)
+            for w, pw in zip(ws, pws)]
 
 
-def _sort_flat(h, l):
-    """Full ascending bitonic sort of the 1024 flat (hi, lo) pairs."""
+def _sort_flat(ws, rows: int = ROWS):
+    """Full ascending bitonic sort of the rows*128 flat keys."""
     import jax.numpy as jnp
 
-    _, lane, flat = _iotas()
+    n = rows * LANES
+    _, lane, flat = _iotas(rows)
     k = 2
-    while k <= N:
+    while k <= n:
         j = k // 2
         while j >= 1:
             is_low = (flat & j) == 0
-            asc = (flat & k) == 0 if k < N else (flat >= 0)
-            hp, hm = _fetch(h, j, lane)
-            lp, lm = _fetch(l, j, lane)
-            ph = jnp.where(is_low, hp, hm)
-            pl_ = jnp.where(is_low, lp, lm)
-            h, l = _cmp_exchange(h, l, ph, pl_, is_low == asc)
+            asc = (flat & k) == 0 if k < n else (flat >= 0)
+            pws = []
+            for w in ws:
+                wp, wm = _fetch(w, j, lane, rows)
+                pws.append(jnp.where(is_low, wp, wm))
+            ws = _cmp_exchange(ws, pws, is_low == asc)
             j //= 2
         k *= 2
-    return h, l
+    return ws
 
 
-def _sort_row(h, l):
+def _sort_row(ws, rows: int = ROWS):
     """Ascending bitonic sort of the 128 lanes of EVERY row
     independently (lane rolls only — pairs never cross rows). Used by
     the mini tier, where the whole frontier+candidates fit one row."""
     import jax.numpy as jnp
     from jax.experimental.pallas import tpu as pltpu
 
-    _, lane, _ = _iotas()
+    _, lane, _ = _iotas(rows)
     k = 2
     while k <= LANES:
         j = k // 2
         while j >= 1:
             is_low = (lane & j) == 0
             asc = (lane & k) == 0 if k < LANES else (lane >= 0)
-            ph = jnp.where(is_low, pltpu.roll(h, LANES - j, 1),
-                           pltpu.roll(h, j, 1))
-            pl_ = jnp.where(is_low, pltpu.roll(l, LANES - j, 1),
-                            pltpu.roll(l, j, 1))
-            h, l = _cmp_exchange(h, l, ph, pl_, is_low == asc)
+            pws = [jnp.where(is_low, pltpu.roll(w, LANES - j, 1),
+                             pltpu.roll(w, j, 1)) for w in ws]
+            ws = _cmp_exchange(ws, pws, is_low == asc)
             j //= 2
         k *= 2
-    return h, l
+    return ws
 
 
 def _mini_width(P: int) -> int:
     """Frontier size served by the single-row tier: the 128 lanes
     split into P+1 equal groups (frontier + one per candidate chunk) —
-    e.g. 42 configs at P=2, 18 at P=6."""
+    e.g. 42 configs at P=2, 18 at P=6, 11 at P=10."""
     return LANES // (P + 1)
 
 
-def _dedup_count_row(h, l):
+def _sentinel(ws, cond):
+    """Replace keys where ``cond`` with the sentinel."""
+    import jax.numpy as jnp
+
+    out = [jnp.where(cond, SENT_LO, w) for w in ws[:-1]]
+    out.append(jnp.where(cond, SENT_HI, ws[-1]))
+    return out
+
+
+def _dedup_count_row(ws, rows: int):
     """Row-0 dedup after a row sort: sentinel the duplicate neighbours,
     count unique valid keys in row 0."""
     import jax.numpy as jnp
     from jax.experimental.pallas import tpu as pltpu
 
-    row, lane, _ = _iotas()
-    prev_h = pltpu.roll(h, 1, 1)
-    prev_l = pltpu.roll(l, 1, 1)
-    valid = h < SENT_HI
-    dup = valid & (h == prev_h) & (l == prev_l) & (lane > 0)
+    row, lane, _ = _iotas(rows)
+    prev = [pltpu.roll(w, 1, 1) for w in ws]
+    valid = ws[-1] < SENT_HI
+    dup = valid & _ws_eq(ws, prev) & (lane > 0)
     keep = valid & ~dup
     n = jnp.sum((keep & (row == 0)).astype(jnp.int32))
-    return (jnp.where(keep, h, SENT_HI),
-            jnp.where(keep, l, SENT_LO), n)
+    return _sentinel(ws, ~keep), n
 
 
-def _mini_expand(spec, table, stride, h, l):
+def _mini_expand(spec, table, stride, ws):
     """Single-row expansion: frontier in lanes 0..M-1 of row 0
     (M = _mini_width(P)); candidate chunk q lands at lanes
     [M*(q+1), M*(q+2)). All rows compute in lockstep; only row 0 is
@@ -302,156 +359,142 @@ def _mini_expand(spec, table, stride, h, l):
     from jax.experimental.pallas import tpu as pltpu
 
     M = _mini_width(spec.P)
-    _, lane, _ = _iotas()
+    _, lane, _ = _iotas(spec.rows)
     group = lane // M
-    fvalid = (h < SENT_HI) & (lane < M)
-    s = _field(spec, h, l, spec.state_pos, spec.state_bits)
+    fvalid = (ws[-1] < SENT_HI) & (lane < M)
+    s = _field(spec, ws, spec.state_pos, spec.state_bits)
     sbase = s * stride               # loop-invariant row base
-    out_h, out_l = h, l
+    out = list(ws)
     for q in range(spec.P):
-        tq = _field(spec, h, l, spec.slot_pos[q], spec.slot_bits)
+        tq = _field(spec, ws, spec.slot_pos[q], spec.slot_bits)
         pending = tq >= 2
         idx = sbase + jnp.maximum(tq - 2, 0)
-        s2 = _gather_table(table, idx, spec.table_rows)
+        s2 = _gather_table(table, idx, spec.table_rows, spec.rows)
         ok = fvalid & pending & (s2 >= 0)
-        ch, cl = _field_add(spec, h, l, spec.slot_pos[q], -tq)
-        ch, cl = _field_add(spec, ch, cl, spec.state_pos, s2 - s)
-        ch = jnp.where(ok, ch, SENT_HI)
-        cl = jnp.where(ok, cl, SENT_LO)
+        cand = _field_add(spec, ws, spec.slot_pos[q], -tq)
+        cand = _field_add(spec, cand, spec.state_pos, s2 - s)
+        cand = _sentinel(cand, ~ok)
         m = group == q + 1
-        out_h = jnp.where(m, pltpu.roll(ch, M * (q + 1), 1), out_h)
-        out_l = jnp.where(m, pltpu.roll(cl, M * (q + 1), 1), out_l)
-    pad = group > spec.P           # unused groups when P < 7
-    out_h = jnp.where(pad, SENT_HI, out_h)
-    out_l = jnp.where(pad, SENT_LO, out_l)
-    return out_h, out_l
+        out = [jnp.where(m, pltpu.roll(c, M * (q + 1), 1), o)
+               for c, o in zip(cand, out)]
+    pad = group > spec.P           # unused groups when P < rows-1
+    return _sentinel(out, pad)
 
 
-def _dedup_count(h, l):
-    """After a sort: mark duplicate neighbours, return (h', l', n) with
+def _dedup_count(ws, rows: int):
+    """After a sort: mark duplicate neighbours, return (ws', n) with
     dups sentinelled and n = number of unique valid keys."""
     import jax.numpy as jnp
 
-    _, lane, flat = _iotas()
+    _, lane, flat = _iotas(rows)
     # previous element = fetch at flat position -1
-    _, prev_h = _fetch(h, 1, lane)
-    _, prev_l = _fetch(l, 1, lane)
-    valid = h < SENT_HI
-    dup = valid & (h == prev_h) & (l == prev_l) & (flat > 0)
+    prev = [_fetch(w, 1, lane, rows)[1] for w in ws]
+    valid = ws[-1] < SENT_HI
+    dup = valid & _ws_eq(ws, prev) & (flat > 0)
     keep = valid & ~dup
     n = jnp.sum(keep.astype(jnp.int32))
-    h2 = jnp.where(keep, h, SENT_HI)
-    l2 = jnp.where(keep, l, SENT_LO)
-    return h2, l2, n
+    return _sentinel(ws, ~keep), n
 
 
-def _field(spec, h, l, pos, bits):
+def _field(spec, ws, pos, bits):
     word, sh = pos
-    src = l if word == 0 else h
-    return (src >> sh) & ((1 << bits) - 1)
+    return (ws[word] >> sh) & ((1 << bits) - 1)
 
 
-def _field_add(spec, h, l, pos, delta):
+def _field_add(spec, ws, pos, delta):
     """Add a (vector) delta into a field; caller guarantees the field
     stays in range so no borrow crosses field boundaries."""
     word, sh = pos
-    if word == 0:
-        return h, l + (delta << sh)
-    return h + (delta << sh), l
+    out = list(ws)
+    out[word] = out[word] + (delta << sh)
+    return out
 
 
-def _gather_table(table, idx, table_rows):
+def _gather_table(table, idx, table_rows, rows: int = ROWS):
     """Flat-indexed gather from a (table_rows_pad, 128) block:
     out[e] = table_flat[idx[e]], idx < table_rows*128. Unrolled
     row-broadcast + lane gather."""
     import jax.numpy as jnp
 
-    out = jnp.full((ROWS, LANES), -1, jnp.int32)
+    out = jnp.full((rows, LANES), -1, jnp.int32)
     r = idx >> 7
     c = idx & 127
     for rr in range(table_rows):
-        rowv = jnp.broadcast_to(table[rr:rr + 1, :], (ROWS, LANES))
+        rowv = jnp.broadcast_to(table[rr:rr + 1, :], (rows, LANES))
         g = jnp.take_along_axis(rowv, c, axis=1)
         out = jnp.where(r == rr, g, out)
     return out
 
 
-def _expand(spec, table, stride, h, l):
+def _expand(spec, table, stride, ws):
     """Rows 1..P <- candidates (slot q of each frontier config
     linearized), rows P+1.. <- sentinel. Row 0 (the frontier) is kept.
     ``stride`` is the runtime table row stride."""
     import jax.numpy as jnp
 
-    row, _, _ = _iotas()
-    fh = jnp.broadcast_to(h[0:1, :], (ROWS, LANES))
-    fl = jnp.broadcast_to(l[0:1, :], (ROWS, LANES))
-    fvalid = fh < SENT_HI
-    s = _field(spec, fh, fl, spec.state_pos, spec.state_bits)
+    row, _, _ = _iotas(spec.rows)
+    f = [jnp.broadcast_to(w[0:1, :], (spec.rows, LANES)) for w in ws]
+    fvalid = f[-1] < SENT_HI
+    s = _field(spec, f, spec.state_pos, spec.state_bits)
     sbase = s * stride               # loop-invariant row base
-    out_h, out_l = h, l
+    out = list(ws)
     for q in range(spec.P):
-        tq = _field(spec, fh, fl, spec.slot_pos[q], spec.slot_bits)
+        tq = _field(spec, f, spec.slot_pos[q], spec.slot_bits)
         pending = tq >= 2
         idx = sbase + jnp.maximum(tq - 2, 0)
-        s2 = _gather_table(table, idx, spec.table_rows)
+        s2 = _gather_table(table, idx, spec.table_rows, spec.rows)
         ok = fvalid & pending & (s2 >= 0)
-        ch, cl = _field_add(spec, fh, fl, spec.slot_pos[q], -tq)
-        ch, cl = _field_add(spec, ch, cl, spec.state_pos, s2 - s)
+        cand = _field_add(spec, f, spec.slot_pos[q], -tq)
+        cand = _field_add(spec, cand, spec.state_pos, s2 - s)
+        cand = _sentinel(cand, ~ok)
         m = row == (q + 1)
-        out_h = jnp.where(m, jnp.where(ok, ch, SENT_HI), out_h)
-        out_l = jnp.where(m, jnp.where(ok, cl, SENT_LO), out_l)
-    m_pad = row > spec.P
-    out_h = jnp.where(m_pad, SENT_HI, out_h)
-    out_l = jnp.where(m_pad, SENT_LO, out_l)
-    return out_h, out_l
+        out = [jnp.where(m, c, o) for c, o in zip(cand, out)]
+    return _sentinel(out, row > spec.P)
 
 
-def _slot_field_runtime(spec, h, l, p):
+def _slot_field_runtime(spec, ws, p):
     """Extract slot p where p is a runtime scalar (unrolled select)."""
     import jax.numpy as jnp
 
-    out = jnp.zeros((ROWS, LANES), jnp.int32)
+    out = jnp.zeros((spec.rows, LANES), jnp.int32)
     for q in range(spec.P):
         out = jnp.where(p == q,
-                        _field(spec, h, l, spec.slot_pos[q],
+                        _field(spec, ws, spec.slot_pos[q],
                                spec.slot_bits),
                         out)
     return out
 
 
-def _slot_add_runtime(spec, h, l, p, delta, mask):
+def _slot_add_runtime(spec, ws, p, delta, mask):
     """Add delta to slot p (runtime scalar) on lanes where mask."""
     import jax.numpy as jnp
 
     for q in range(spec.P):
-        h2, l2 = _field_add(spec, h, l, spec.slot_pos[q], delta)
+        cand = _field_add(spec, ws, spec.slot_pos[q], delta)
         m = mask & (p == q)
-        h = jnp.where(m, h2, h)
-        l = jnp.where(m, l2, l)
-    return h, l
+        ws = [jnp.where(m, c, w) for c, w in zip(cand, ws)]
+    return ws
 
 
 RESET = -2     # ok_proc marker: flush current history, start the next
 
 
 def _root_key(spec):
-    """(hi0, lo0) ints of the empty config (all slots IDLE, state 0)."""
-    h0 = l0 = 0
+    """Per-word ints (least significant first) of the empty config
+    (all slots IDLE, state 0)."""
+    words = [0] * spec.n_words
     for q in range(spec.P):
         w, sh = spec.slot_pos[q]
-        if w == 0:
-            l0 |= 1 << sh
-        else:
-            h0 |= 1 << sh
-    return h0, l0
+        words[w] |= 1 << sh
+    return words
 
 
 def _build_kernel(spec: SegKernelSpec):
     """The chunk kernel. Grid = (chunk,); scalar-prefetch args:
     seg[chunk, 2+2K] (ok_proc, depth, inv_proc.., inv_tr..) and
-    off[1] (global segment offset). Inputs: carry_hi, carry_lo (8,128),
-    carry_stat (1,128) [status, fail, n, hist-counter], results
-    (B_pad, 128), table (rows,128). Outputs: the same carries.
+    off[1] (global segment offset). Inputs: n_words key-word carries
+    (rows,128), carry_stat (1,128) [status, fail, n, hist-counter],
+    results (B_pad, 128), table (rows,128). Outputs: the same carries.
 
     A segment with ok_proc == RESET is a history boundary in a
     multi-history stream: the current history's (status, fail, n) row
@@ -462,18 +505,26 @@ def _build_kernel(spec: SegKernelSpec):
     from jax import lax
     from jax.experimental import pallas as pl
 
-    P, K = spec.P, spec.K
+    P, K, W, rows = spec.P, spec.K, spec.n_words, spec.rows
 
-    root_hi, root_lo = _root_key(spec)
+    root = _root_key(spec)
 
-    def kernel(seg_ref, off_ref, hi_in, lo_in, st_in, res_in, tab_ref,
-               hi_out, lo_out, st_out, res_out, whi, wlo, sstat):
+    def kernel(seg_ref, off_ref, *refs):
+        # refs: W word carries in, st_in, res_in, tab_ref,
+        #       W word carries out, st_out, res_out,
+        #       W VMEM word scratch, sstat SMEM
+        ws_in = refs[:W]
+        st_in, res_in, tab_ref = refs[W], refs[W + 1], refs[W + 2]
+        ws_out = refs[W + 3:2 * W + 3]
+        st_out, res_out = refs[2 * W + 3], refs[2 * W + 4]
+        wsc = refs[2 * W + 5:3 * W + 5]
+        sstat = refs[3 * W + 5]
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _():
-            whi[:] = hi_in[:]
-            wlo[:] = lo_in[:]
+            for w in range(W):
+                wsc[w][:] = ws_in[w][:]
             res_out[:] = res_in[:]
             sstat[0] = st_in[0, 0]      # status
             sstat[1] = st_in[0, 1]      # fail seg (global)
@@ -485,7 +536,7 @@ def _build_kernel(spec: SegKernelSpec):
 
         @pl.when(ok_p == RESET)
         def _():
-            row, lane, _ = _iotas()
+            row, lane, _ = _iotas(rows)
             cnt = sstat[6]
 
             @pl.when(cnt >= 0)
@@ -501,16 +552,17 @@ def _build_kernel(spec: SegKernelSpec):
             sstat[0] = VALID
             sstat[1] = -1
             sstat[2] = 1
-            root = (row == 0) & (lane == 0)
-            whi[:] = jnp.where(root, root_hi, SENT_HI)
-            wlo[:] = jnp.where(root, root_lo, SENT_LO)
+            at_root = (row == 0) & (lane == 0)
+            for w in range(W):
+                sent = SENT_HI if w == W - 1 else SENT_LO
+                wsc[w][:] = jnp.where(at_root, root[w], sent)
 
         live = (sstat[0] == VALID) & (ok_p >= 0)
 
         @pl.when(live)
         def _():
-            row, _, _ = _iotas()
-            h, l = whi[:], wlo[:]
+            row, _, _ = _iotas(rows)
+            ws = [wsc[w][:] for w in range(W)]
             table = tab_ref[:]
             stride = off_ref[1]      # runtime table row stride
             frow = row == 0
@@ -518,8 +570,8 @@ def _build_kernel(spec: SegKernelSpec):
             for k in range(K):
                 p = seg_ref[i, 2 + k]
                 tr = seg_ref[i, 2 + K + k]
-                m = frow & (h < SENT_HI) & (p >= 0)
-                h, l = _slot_add_runtime(spec, h, l, p, tr + 1, m)
+                m = frow & (ws[-1] < SENT_HI) & (p >= 0)
+                ws = _slot_add_runtime(spec, ws, p, tr + 1, m)
 
             # --- closure: bounded fixed point ------------------------
             # sstat[3]: continue flag, sstat[4]: overflow, sstat[5]: n
@@ -528,36 +580,32 @@ def _build_kernel(spec: SegKernelSpec):
             sstat[5] = sstat[2]
 
             def body(it, carry):
-                ch, cl = carry
+                cws = list(carry)
 
                 def run(args):
-                    ch, cl = args
+                    cws = list(args)
 
                     def full(args):
-                        ch, cl = args
-                        eh, el = _expand(spec, table, stride, ch, cl)
-                        eh, el = _sort_flat(eh, el)
-                        eh, el, n2 = _dedup_count(eh, el)
-                        return eh, el, n2
+                        ews = _expand(spec, table, stride, list(args))
+                        ews = _sort_flat(ews, rows)
+                        ews, n2 = _dedup_count(ews, rows)
+                        return tuple(ews) + (n2,)
 
                     def mini(args):
                         # frontier fits one lane group (128/(P+1)
                         # lanes): the whole iteration stays in row 0
                         # and the sorts are 28 lane-only stages
-                        # instead of 55 flat ones
-                        ch, cl = args
-                        eh, el = _mini_expand(spec, table, stride,
-                                              ch, cl)
-                        eh, el = _sort_row(eh, el)
-                        eh, el, n2 = _dedup_count_row(eh, el)
-                        nrow = row > 0
-                        eh = jnp.where(nrow, SENT_HI, eh)
-                        el = jnp.where(nrow, SENT_LO, el)
-                        return eh, el, n2
+                        # instead of the full flat ones
+                        ews = _mini_expand(spec, table, stride,
+                                           list(args))
+                        ews = _sort_row(ews, rows)
+                        ews, n2 = _dedup_count_row(ews, rows)
+                        ews = _sentinel(ews, row > 0)
+                        return tuple(ews) + (n2,)
 
                     use_mini = sstat[5] <= _mini_width(P)
-                    eh, el, n2 = lax.cond(use_mini, mini, full,
-                                          (ch, cl))
+                    out = lax.cond(use_mini, mini, full, tuple(cws))
+                    ews, n2 = list(out[:W]), out[W]
                     ovf = (n2 > F).astype(jnp.int32)
                     changed = (n2 > sstat[5]).astype(jnp.int32)
                     sstat[4] = sstat[4] | ovf
@@ -565,36 +613,35 @@ def _build_kernel(spec: SegKernelSpec):
                     sstat[5] = n2
 
                     def compact2(args):
-                        eh, el, was_mini = args
-                        eh, el = lax.cond(
+                        was_mini = args[W]
+                        return lax.cond(
                             was_mini,
-                            lambda a: _sort_row(*a),
-                            lambda a: _sort_flat(*a), (eh, el))
-                        return eh, el
+                            lambda a: tuple(_sort_row(list(a), rows)),
+                            lambda a: tuple(_sort_flat(list(a), rows)),
+                            args[:W])
 
                     # no growth => the deduped union IS the previous
                     # frontier; restore it and skip the compaction sort
                     return lax.cond(changed == 1, compact2,
-                                    lambda a: (ch, cl),
-                                    (eh, el, use_mini))
+                                    lambda a: tuple(cws),
+                                    tuple(ews) + (use_mini,))
 
                 return lax.cond(sstat[3] == 1, run, lambda a: a,
-                                (ch, cl))
+                                tuple(cws))
 
-            h, l = lax.fori_loop(0, depth, body, (h, l))
+            ws = list(lax.fori_loop(0, depth, body, tuple(ws)))
 
             # --- ok filter: keep configs whose ok-slot linearized ----
-            tq_ok = _slot_field_runtime(spec, h, l, ok_p)
-            returned = frow & (h < SENT_HI) & (tq_ok == 0)
+            tq_ok = _slot_field_runtime(spec, ws, ok_p)
+            returned = frow & (ws[-1] < SENT_HI) & (tq_ok == 0)
             # clear the slot back to IDLE (LIN=0 -> +1)
-            h, l = _slot_add_runtime(spec, h, l, ok_p, 1, returned)
-            h = jnp.where(frow & ~returned, SENT_HI, h)
-            l = jnp.where(frow & ~returned, SENT_LO, l)
+            ws = _slot_add_runtime(spec, ws, ok_p, 1, returned)
+            ws = _sentinel(ws, frow & ~returned)
             n2 = jnp.sum(returned.astype(jnp.int32))
             # re-compact row 0 (survivors are a scatter of the closed
             # frontier): one row sort keeps the "frontier contiguous
             # from lane 0" invariant the mini tier relies on
-            h, l = _sort_row(h, l)
+            ws = _sort_row(ws, rows)
 
             ovf = sstat[4] == 1
             st_new = jnp.where(ovf, UNKNOWN,
@@ -603,14 +650,14 @@ def _build_kernel(spec: SegKernelSpec):
                                  off_ref[0] + i)
             sstat[0] = st_new
             sstat[2] = n2
-            whi[:] = h
-            wlo[:] = l
+            for w in range(W):
+                wsc[w][:] = ws[w]
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _():
-            hi_out[:] = whi[:]
-            lo_out[:] = wlo[:]
-            _, lane0, _ = _iotas()
+            for w in range(W):
+                ws_out[w][:] = wsc[w][:]
+            _, lane0, _ = _iotas(rows)
             stat_row = jnp.where(
                 lane0[0:1, :] == 0, sstat[0],
                 jnp.where(lane0[0:1, :] == 1, sstat[1],
@@ -632,37 +679,38 @@ def _chunk_call(spec: SegKernelSpec, b_pad: int = 8):
     from jax.experimental.pallas import tpu as pltpu
 
     kernel = _build_kernel(spec)
+    W, rows = spec.n_words, spec.rows
+    word_spec = pl.BlockSpec((rows, LANES), lambda i, *s: (0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(spec.chunk,),
-        in_specs=[
-            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
-            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+        in_specs=[word_spec] * W + [
             pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((b_pad, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((spec.table_rows_pad, LANES),
                          lambda i, *s: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
-            pl.BlockSpec((ROWS, LANES), lambda i, *s: (0, 0)),
+        out_specs=[word_spec] * W + [
             pl.BlockSpec((1, LANES), lambda i, *s: (0, 0)),
             pl.BlockSpec((b_pad, LANES), lambda i, *s: (0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((ROWS, LANES), jnp.int32),
-                        pltpu.VMEM((ROWS, LANES), jnp.int32),
-                        pltpu.SMEM((8,), jnp.int32)])
+        scratch_shapes=[pltpu.VMEM((rows, LANES), jnp.int32)] * W
+        + [pltpu.SMEM((8,), jnp.int32)])
 
-    def call(seg, off, hi, lo, stat, res, table):
-        return pl.pallas_call(
+    word_shape = jax.ShapeDtypeStruct((rows, LANES), jnp.int32)
+
+    def call(seg, off, ws, stat, res, table):
+        """``ws`` is the list/tuple of word carries; returns
+        (ws_out_tuple, stat, res)."""
+        out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
-                       jax.ShapeDtypeStruct((ROWS, LANES), jnp.int32),
-                       jax.ShapeDtypeStruct((1, LANES), jnp.int32),
-                       jax.ShapeDtypeStruct((b_pad, LANES), jnp.int32)],
+            out_shape=[word_shape] * W + [
+                jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+                jax.ShapeDtypeStruct((b_pad, LANES), jnp.int32)],
             interpret=_INTERPRET,
-        )(seg, off, hi, lo, stat, res, table)
+        )(seg, off, *ws, stat, res, table)
+        return tuple(out[:W]), out[W], out[W + 1]
 
     return call
 
@@ -701,30 +749,30 @@ def _scan_fn(spec: SegKernelSpec, b_pad: int = 8,
     call = _chunk_call(spec, b_pad)
 
     @jax.jit
-    def run(seg_chunks, hi0, lo0, stat0, res0, table, stride):
+    def run(seg_chunks, ws0, stat0, res0, table, stride):
         n_chunks = seg_chunks.shape[0]
 
         def step(carry, x):
-            hi, lo, stat, res = carry
+            ws, stat, res = carry
             seg, off = x
 
             def live(_):
-                return tuple(call(seg, off, hi, lo, stat, res, table))
+                return call(seg, off, ws, stat, res, table)
 
             if stream:
                 out = live(None)
             else:
                 out = lax.cond(stat[0, 0] == VALID, live,
-                               lambda _: (hi, lo, stat, res), None)
+                               lambda _: (ws, stat, res), None)
             return out, None
 
         starts = (jnp.arange(n_chunks, dtype=jnp.int32)
                   * jnp.int32(spec.chunk)).reshape(n_chunks, 1)
         offs = jnp.concatenate(
             [starts, jnp.full((n_chunks, 1), jnp.int32(stride))], axis=1)
-        (hi, lo, stat, res), _ = lax.scan(
-            step, (hi0, lo0, stat0, res0), (seg_chunks, offs))
-        return hi, lo, stat, res
+        (ws, stat, res), _ = lax.scan(
+            step, (tuple(ws0), stat0, res0), (seg_chunks, offs))
+        return ws, stat, res
 
     return run
 
@@ -738,11 +786,11 @@ def check_device_pallas(succ: np.ndarray, segs, *, n_states: int,
     prep = _prepare(succ, segs, n_states, n_transitions, P)
     if prep is None:
         return None
-    spec, seg_chunks, hi0, lo0, stat0, table = prep
+    spec, seg_chunks, ws0, stat0, table = prep
     run = _scan_fn(spec)
     res0 = jnp.zeros((8, LANES), jnp.int32)      # unused: no RESETs
-    hi, lo, stat, _ = run(jnp.asarray(seg_chunks), hi0, lo0, stat0,
-                          res0, table, n_transitions)
+    _, stat, _ = run(jnp.asarray(seg_chunks), tuple(ws0), stat0,
+                     res0, table, n_transitions)
     stat = np.asarray(stat)
     return int(stat[0, 0]), int(stat[0, 1]), int(stat[0, 2])
 
@@ -874,17 +922,19 @@ def _stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
     while b_pad < B:
         b_pad *= 2
     chunks, starts = pack_stream(segs_list, spec)
-    hi0, lo0 = initial_frontier(spec)
+    ws0 = initial_frontier(spec)
     table = pack_table(succ[:n_states, :n_transitions],
                        spec.table_rows_pad)
-    args = [chunks, hi0, lo0, _init_stat(),
-            np.zeros((b_pad, LANES), np.int32), table]
+    args = [chunks] + ws0 + [_init_stat(),
+                             np.zeros((b_pad, LANES), np.int32), table]
     if device is not None:
         args = [jax.device_put(a, device) for a in args]
     else:
         args = [jnp.asarray(a) for a in args]
+    W = spec.n_words
     run = _scan_fn(spec, b_pad=b_pad, stream=True)
-    _, _, _, res = run(*args, n_transitions)
+    _, _, res = run(args[0], tuple(args[1:1 + W]), *args[1 + W:],
+                    n_transitions)
     return res, starts
 
 
@@ -900,11 +950,10 @@ def _prepare(succ, segs, n_states, n_transitions, P):
     if spec is None:
         return None
     seg_chunks = pack_segments(segs, spec)
-    hi, lo = (jnp.asarray(a) for a in initial_frontier(spec))
+    ws = [jnp.asarray(a) for a in initial_frontier(spec)]
     table = jnp.asarray(pack_table(succ[:n_states, :n_transitions],
                                    spec.table_rows_pad))
-    return (spec, seg_chunks, hi, lo, jnp.asarray(_init_stat()),
-            table)
+    return (spec, seg_chunks, ws, jnp.asarray(_init_stat()), table)
 
 
 def check_device_pallas_chunked(succ: np.ndarray, segs, *,
@@ -918,10 +967,10 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     reference's 5-second reporter cadence, ``linear.clj:273-297``).
 
     With ``return_boundary`` the result gains a 4th element
-    ``(hi, lo, done)``: the packed frontier at the last chunk boundary
-    BEFORE the failure and the number of segments consumed up to it —
-    the seed for bounded counterexample reconstruction (decode with
-    :func:`decode_frontier`)."""
+    ``(ws, done)``: the packed frontier word list at the last chunk
+    boundary BEFORE the failure and the number of segments consumed up
+    to it — the seed for bounded counterexample reconstruction (decode
+    with :func:`decode_frontier`)."""
     import time
 
     import jax.numpy as jnp
@@ -929,30 +978,30 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     prep = _prepare(succ, segs, n_states, n_transitions, P)
     if prep is None:
         return None
-    spec, seg_chunks, hi, lo, stat, table = prep
+    spec, seg_chunks, ws, stat, table = prep
     call = _chunk_jit(spec)
+    ws = tuple(ws)
     res = jnp.zeros((8, LANES), jnp.int32)       # unused: no RESETs
     s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
     t_run = time.monotonic()
     last = t_run
-    prev_hi, prev_lo, done = hi, lo, 0
+    prev_ws, done = ws, 0
     visited = 0
     for c in range(seg_chunks.shape[0]):
         off = np.array([c * spec.chunk, n_transitions], np.int32)
-        hi, lo, stat, res = call(jnp.asarray(seg_chunks[c]),
-                                 jnp.asarray(off), hi, lo,
-                                 stat, res, table)
+        ws, stat, res = call(jnp.asarray(seg_chunks[c]),
+                             jnp.asarray(off), ws, stat, res, table)
         st = np.asarray(stat)
         visited += int(st[0, 2]) * spec.chunk
         if int(st[0, 0]) != VALID:
             break
-        prev_hi, prev_lo, done = hi, lo, (c + 1) * spec.chunk
+        prev_ws, done = ws, (c + 1) * spec.chunk
         now = time.monotonic()
         if progress is not None and now - last >= progress_interval_s:
             from .linear_jax import estimated_cost
 
-            cfgs = decode_frontier(spec, np.asarray(hi),
-                                   np.asarray(lo), spec.P)
+            cfgs = decode_frontier(
+                spec, [np.asarray(w) for w in ws], spec.P)
             pend = [sum(1 for t in sl if t >= 0) for _, sl in cfgs]
             el = max(now - t_run, 1e-9)
             progress(min((c + 1) * spec.chunk, s_real), s_real,
@@ -964,28 +1013,26 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     st = np.asarray(stat)
     out = (int(st[0, 0]), int(st[0, 1]), int(st[0, 2]))
     if return_boundary:
-        return out + ((np.asarray(prev_hi), np.asarray(prev_lo),
+        return out + (([np.asarray(w) for w in prev_ws],
                        min(done, s_real)),)
     return out
 
 
-def decode_frontier(spec: SegKernelSpec, hi: np.ndarray,
-                    lo: np.ndarray, P: int):
-    """Decode a kernel frontier (packed keys, row 0) into host configs
-    ``(state, slots)`` in the :mod:`~.linear_host` encoding: the slot
-    field stores LIN=0 / IDLE=1 / tr+2, so subtracting 2 maps straight
-    to LIN=-2 / IDLE=-1 / tr. Padding slots beyond ``P`` are dropped
-    (always IDLE)."""
+def decode_frontier(spec: SegKernelSpec, ws, P: int):
+    """Decode a kernel frontier (packed key word list, row 0) into host
+    configs ``(state, slots)`` in the :mod:`~.linear_host` encoding:
+    the slot field stores LIN=0 / IDLE=1 / tr+2, so subtracting 2 maps
+    straight to LIN=-2 / IDLE=-1 / tr. Padding slots beyond ``P`` are
+    dropped (always IDLE)."""
     def field(pos, bits):
         word, sh = pos
-        src = lo[0] if word == 0 else hi[0]
-        return (src >> sh) & ((1 << bits) - 1)
+        return (ws[word][0] >> sh) & ((1 << bits) - 1)
 
     state = field(spec.state_pos, spec.state_bits)
     slots = [field(spec.slot_pos[q], spec.slot_bits)
              for q in range(min(P, spec.P))]
     out = set()
-    for lane in np.flatnonzero(hi[0] < SENT_HI):
+    for lane in np.flatnonzero(ws[-1][0] < SENT_HI):
         out.add((int(state[lane]),
                  tuple(int(slots[q][lane]) - 2
                        for q in range(min(P, spec.P)))))

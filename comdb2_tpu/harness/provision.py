@@ -1,0 +1,172 @@
+"""Cluster provisioning: install, configure and cycle ``sut_node`` on
+remote hosts through a :class:`~comdb2_tpu.control.remote.Remote`
+transport — the role of the reference's ``scripts/newdb`` /
+``scripts/setvars`` / ``scripts/addmach_comdb2db`` provisioning scripts
+(machines m1..m5, ``scripts/setvars:7``) plus ``jepsen.db``'s
+setup/teardown/cycle contract (``db.clj:4-25``; round-3 VERDICT
+Missing #4: ``SSHRemote`` existed but nothing installed or configured
+a SUT on fresh nodes).
+
+A node name maps to (host, client_port) via ``layout``; the SUT's
+replication mesh is wired from the same layout (``sut_node -n`` takes
+``host:port`` entries since round 4). With every node on localhost and
+a :class:`~comdb2_tpu.control.remote.LocalRemote` this provisions a
+real cluster in CI; pointing the layout at real hosts with an
+``SSHRemote`` is the same code path (the binary is uploaded, so hosts
+need nothing pre-installed beyond libc).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..control.remote import Remote, RemoteError
+from . import db as db_ns
+
+
+@dataclass
+class NodeLayout:
+    """Where each logical node lives: host + client/replication port.
+    One process per node; all ports distinct when hosts collide
+    (the localhost-CI case)."""
+
+    host: str
+    port: int
+
+
+class SutNodeDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
+    """DB-protocol provisioner for the in-tree replicated SUT.
+
+    ``setup`` uploads the binary (once per host), wipes the node's
+    state dir, writes a config file recording the flags (the
+    ``setvars`` role — the run is reproducible from the artifact), and
+    starts the daemon with a pidfile; ``teardown`` kills it.
+    ``cycle`` (teardown + setup, ``db.clj:17-25``) therefore gives
+    every test run a fresh, freshly-configured cluster.
+    """
+
+    def __init__(self, remote: Remote, binary: str,
+                 layout: Dict[str, NodeLayout],
+                 base_dir: str = "/tmp/comdb2tpu-sut",
+                 timeout_ms: int = 500, elect_ms: int = 500,
+                 lease_ms: int = 300, persistent: bool = True,
+                 flags: Sequence[str] = ()):
+        self.remote = remote
+        self.binary = binary
+        self.layout = dict(layout)
+        self.base_dir = base_dir
+        self.timeout_ms = timeout_ms
+        self.elect_ms = elect_ms
+        self.lease_ms = lease_ms
+        self.persistent = persistent
+        self.flags = list(flags)
+        self._installed: set = set()
+
+    # -- paths ---------------------------------------------------------
+
+    def _dir(self, node: str) -> str:
+        return f"{self.base_dir}/{node}"
+
+    def _bin(self, node: str) -> str:
+        return f"{self._dir(node)}/sut_node"
+
+    def _pidfile(self, node: str) -> str:
+        return f"{self._dir(node)}/pid"
+
+    def _logfile(self, node: str) -> str:
+        return f"{self._dir(node)}/sut.log"
+
+    def _peers(self, test: dict) -> str:
+        """The ``-n host:port,...`` mesh, ordered by test node list."""
+        return ",".join(
+            f"{self.layout[n].host}:{self.layout[n].port}"
+            for n in test["nodes"])
+
+    def _node_id(self, test: dict, node: str) -> int:
+        return list(test["nodes"]).index(node)
+
+    # -- DB protocol ---------------------------------------------------
+
+    def setup(self, test: dict, node: str) -> None:
+        host = self.layout[node].host
+        d = self._dir(node)
+        self.remote.execute(host, f"mkdir -p {d} && rm -rf {d}/state")
+        if (host, node) not in self._installed:
+            self.remote.upload(host, self.binary, self._bin(node))
+            self.remote.execute(host, f"chmod +x {self._bin(node)}")
+            self._installed.add((host, node))
+        i = self._node_id(test, node)
+        args = [self._bin(node), "-i", str(i), "-n", self._peers(test),
+                "-t", str(self.timeout_ms),
+                "-e", str(self.elect_ms), "-l", str(self.lease_ms)]
+        if self.persistent:
+            args += ["-d", f"{d}/state"]
+        args += self.flags
+        cmd = " ".join(args)
+        # the setvars role: the exact configuration is an artifact
+        self.remote.execute(
+            host, f"printf '%s\\n' '{cmd}' > {d}/config")
+        self.remote.execute(
+            host,
+            f"nohup {cmd} > {self._logfile(node)} 2>&1 & "
+            f"echo $! > {self._pidfile(node)}")
+        self._await_ready(host, self.layout[node].port)
+
+    def teardown(self, test: dict, node: str) -> None:
+        host = self.layout[node].host
+        pf = self._pidfile(node)
+        self.remote.execute(
+            host, f"[ -f {pf} ] && kill -9 $(cat {pf}) 2>/dev/null; "
+                  f"rm -f {pf}; true")
+
+    def setup_primary(self, test: dict, node: str) -> None:
+        """Elections pick the primary; wait until one exists so the
+        first client op doesn't race the first election (persistent
+        nodes always boot as replicas)."""
+        self._await_primary(test)
+
+    def log_files(self, test: dict, node: str) -> List[str]:
+        return [self._logfile(node)]
+
+    # -- readiness -----------------------------------------------------
+
+    def _probe(self, host: str, port: int, req: str) -> str:
+        """One request/reply through the transport (the control plane
+        may be the only path to the node — client ports need not be
+        reachable from the harness host)."""
+        r = self.remote.execute(
+            host,
+            "timeout 1 bash -c 'exec 3<>/dev/tcp/127.0.0.1/%d; "
+            "printf \"%s\\n\" >&3; head -n1 <&3' 2>/dev/null"
+            % (port, req))
+        return (r.out or "").strip()
+
+    def _await_ready(self, host: str, port: int,
+                     deadline_s: float = 10.0) -> None:
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            if self._probe(host, port, "P") == "PONG":
+                return
+            time.sleep(0.1)
+        raise RuntimeError(f"sut_node on {host}:{port} not ready")
+
+    def _await_primary(self, test: dict,
+                       deadline_s: float = 15.0) -> None:
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            for n in test["nodes"]:
+                lay = self.layout[n]
+                info = self._probe(lay.host, lay.port, "I")
+                if " primary " in f" {info} ":
+                    return
+            time.sleep(0.15)
+        raise RuntimeError("no primary elected during setup")
+
+
+def local_layout(nodes: Sequence[str],
+                 ports: Sequence[int]) -> Dict[str, NodeLayout]:
+    """All nodes on localhost with distinct ports — the CI shape."""
+    return {n: NodeLayout("127.0.0.1", p)
+            for n, p in zip(nodes, ports)}

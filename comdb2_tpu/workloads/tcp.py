@@ -11,6 +11,7 @@ hung-op behavior the checker must reason about.
 from __future__ import annotations
 
 import socket
+import time
 from typing import Optional, Sequence, Tuple
 
 from ..harness import client as client_ns
@@ -754,3 +755,106 @@ class G2TcpClient(_ClusterTxnClientBase):
                 txn.insert("b", k, b_id, 30)
             return None
         return self._run_txn(op, body)
+
+
+class DirtyReadsTcpClient(_ClusterTxnClientBase):
+    """The dirty-reads workload over the wire
+    (``comdb2/core.clj:320-355``): ``write x`` updates every row of the
+    dirty table to x in ONE txn (reading each row first, so the commit
+    carries a read set); ``read`` returns all rows' values from one
+    read-only txn. A row value from a write that reported :fail is the
+    anomaly (``core.clj:492-523``); a non-uniform read is an
+    inconsistent (torn) read. The ``-R`` dirty-commit control applies
+    conflicted txns while reporting FAIL — the classic
+    effects-misclassification bug this workload exists to catch.
+
+    Rows live at register keys ``base .. base+n-1`` (base offsets the
+    dirty table away from other workloads' keys)."""
+
+    def __init__(self, ports, n: int, base: int = 10_000,
+                 timeout_s: float = 1.0):
+        super().__init__(ports, timeout_s)
+        self.n = n
+        self.base = base
+
+    def _clone(self):
+        return DirtyReadsTcpClient(self.ports, self.n, self.base,
+                                   self.timeout_s)
+
+    def setup(self, test, node):
+        c = super().setup(test, node)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            txn = ClusterTxn(c.conn)
+            try:
+                txn.begin()
+                missing = [i for i in range(c.n)
+                           if txn.read(c.base + i) is None]
+                for i in missing:
+                    txn.write(c.base + i, -1)
+                if txn.commit(c._nonce()) == "ok" or not missing:
+                    return c
+            except (TxnAborted, TimeoutError, OSError):
+                pass
+            time.sleep(0.1)
+        raise RuntimeError("could not initialize dirty rows")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            def body(txn):
+                vals = []
+                for i in range(self.n):
+                    v = txn.read(self.base + i)
+                    if v is None:
+                        raise TxnAborted("uninitialized row")
+                    vals.append(v)
+                # skip initializer rows, like the reference's
+                # ``where x != -1`` (core.clj:341)
+                return tuple(v for v in vals if v != -1)
+            return self._run_txn(op, body, read_only=True)
+        if op["f"] == "write":
+            import random as _random
+
+            x = op["value"]
+            order = list(range(self.n))
+            _random.shuffle(order)
+
+            def body(txn):
+                for i in order:
+                    txn.read(self.base + i)
+                for i in order:
+                    txn.write(self.base + i, x)
+                return None
+            return self._run_txn(op, body)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+
+class CounterTcpClient(_ClusterTxnClientBase):
+    """The counter workload over the wire (``checker.clj:220-272``):
+    ``add v`` reads the counter register and writes back the sum in one
+    OCC txn (a conflicted add cleanly fails and is retried by the
+    generator's next op); ``read`` returns the register from a
+    read-only txn. ``-T`` (no validation) loses concurrent updates, so
+    a later read falls below the sum of acknowledged adds — the
+    counter checker's lower bound."""
+
+    KEY = 20_000
+
+    def _clone(self):
+        return CounterTcpClient(self.ports, self.timeout_s)
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            def body(txn):
+                v = txn.read(self.KEY)
+                return 0 if v is None else v
+            return self._run_txn(op, body, read_only=True)
+        if op["f"] == "add":
+            v = op["value"]
+
+            def body(txn):
+                cur = txn.read(self.KEY)
+                txn.write(self.KEY, (0 if cur is None else cur) + v)
+                return None
+            return self._run_txn(op, body)
+        raise ValueError(f"unknown f {op['f']!r}")

@@ -88,6 +88,7 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <netdb.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -222,6 +223,7 @@ struct Node {
     int lease_ms = 350;         /* quorum-contact freshness for serving */
     int elect_ms = 600;         /* election timeout base (+150*id) */
     std::vector<int> ports;
+    std::vector<std::string> hosts;         /* peer addresses ("-n") */
 
     std::mutex mu;
     std::condition_variable cv;
@@ -293,6 +295,13 @@ struct Node {
     };
     std::map<long long, Txn> txns;
     long long next_txid = 1;
+    bool dirty_commit = false;  /* negative control: a validation
+                                 * conflict still APPLIES the txn but
+                                 * tells the client FAIL — the
+                                 * effects-misclassification bug the
+                                 * dirty-reads workload hunts (a
+                                 * failed write's value visible,
+                                 * comdb2/core.clj:492-523) */
     bool buggy_txn = false;     /* negative control: commit without
                                  * validation — lost updates / G2 */
 
@@ -538,7 +547,27 @@ void Node::persist_rewrite_locked() {
 
 /* ---------- small line-protocol client (for forwarding) ----------- */
 
-int dial(int port, int timeout_ms) {
+/* Resolve a "-n" peer entry once at startup (hostnames are static;
+ * getaddrinfo on the election/replication hot paths would let a slow
+ * resolver blow the ~150 ms election budgets). Returns the dotted
+ * address, or "" on failure. */
+std::string resolve_host(const std::string &host) {
+    in_addr a{};
+    if (inet_pton(AF_INET, host.c_str(), &a) == 1) return host;
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+        res == nullptr)
+        return "";
+    char buf[INET_ADDRSTRLEN] = {0};
+    inet_ntop(AF_INET, &((sockaddr_in *)res->ai_addr)->sin_addr, buf,
+              sizeof buf);
+    freeaddrinfo(res);
+    return buf;
+}
+
+int dial(const std::string &host, int port, int timeout_ms) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
     timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
@@ -548,7 +577,10 @@ int dial(int port, int timeout_ms) {
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+        close(fd);       /* hosts are pre-resolved at startup */
+        return -1;
+    }
     addr.sin_port = htons((uint16_t)port);
     if (connect(fd, (sockaddr *)&addr, sizeof addr) != 0) {
         close(fd);
@@ -592,9 +624,9 @@ bool read_line(int fd, std::string *out) {
 }
 
 /* one transient request/reply to a peer; empty string = no answer */
-std::string peer_request(int port, const std::string &line,
-                         int timeout_ms) {
-    int fd = dial(port, timeout_ms);
+std::string peer_request(const std::string &host, int port,
+                         const std::string &line, int timeout_ms) {
+    int fd = dial(host, port, timeout_ms);
     if (fd < 0) return "";
     std::string reply;
     if (!send_all(fd, line + "\n") || !read_line(fd, &reply))
@@ -647,7 +679,7 @@ void sender_thread(int peer) {
             t_sent = n.lease_now_locked();
         }
         if (msg.empty()) continue;
-        if (fd < 0) fd = dial(n.ports[peer], 200);
+        if (fd < 0) fd = dial(n.hosts[peer], n.ports[peer], 200);
         if (fd < 0) {
             /* unreachable peer: back off instead of spinning the dial
              * loop at 100% CPU (loopback refusals fail in µs) */
@@ -726,7 +758,8 @@ void election_thread() {
         int votes = 1;
         for (int p = 0; p < (int)n.ports.size(); p++) {
             if (p == n.id || blocked_copy.count(p)) continue;
-            std::string r = peer_request(n.ports[p], req, 150);
+            std::string r =
+                peer_request(n.hosts[p], n.ports[p], req, 150);
             long long gt = 0;
             int granted = 0;
             if (sscanf(r.c_str(), "G %lld %d", &gt, &granted) == 2) {
@@ -889,6 +922,7 @@ std::string commit_txn(long long txid, unsigned long long nonce) {
     LogEntry e;
     long long lsn = 0, t = 0;
     bool replay = false;
+    bool lied = false;
     {
         std::lock_guard<std::mutex> g(n.mu);
         if (n.role != PRIMARY) return "UNKNOWN";
@@ -920,7 +954,16 @@ std::string commit_txn(long long txid, unsigned long long nonce) {
                                   ? 0
                                   : (long long)v->second.size();
                     }
-                    if (cur != r.ver) return "FAIL";    /* conflict */
+                    if (cur != r.ver) {
+                        if (!n.dirty_commit)
+                            return "FAIL";              /* conflict */
+                        /* --dirty-commit (-R): apply anyway, lie to
+                         * the client. The write becomes visible while
+                         * the client records :fail — exactly the
+                         * anomaly the dirty-reads checker hunts. */
+                        lied = true;
+                        break;
+                    }
                 }
             }
             if (txn.writes.empty()) {
@@ -941,7 +984,9 @@ std::string commit_txn(long long txid, unsigned long long nonce) {
             n.recompute_durable_locked();
         }
     }
-    return commit_wait(lsn, t, replay);
+    std::string out = commit_wait(lsn, t, replay);
+    if (lied) return "FAIL";    /* the entry is in the log regardless */
+    return out;
 }
 
 std::string handle(const std::string &line, bool forwarded = false);
@@ -969,8 +1014,8 @@ std::string forward_to_leader(const std::string &cmd) {
      * (round-3 ADVICE) */
     std::string fwd = "F " + std::to_string(n.id) + " " + cmd;
     /* the leader's durable wait can take timeout_ms on its own */
-    std::string r =
-        peer_request(n.ports[ldr], fwd, n.timeout_ms + 500);
+    std::string r = peer_request(n.hosts[ldr], n.ports[ldr], fwd,
+                                 n.timeout_ms + 500);
     return r.empty() ? "UNKNOWN" : r;
 }
 
@@ -1451,7 +1496,7 @@ int main(int argc, char **argv) {
     std::string peers;
     int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xLNBDTh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xLNBDTRh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
@@ -1465,6 +1510,7 @@ int main(int argc, char **argv) {
         case 'd': n.dir = optarg; break;
         case 'x': n.no_fsync = true; break;
         case 'T': n.buggy_txn = true; break;
+        case 'R': n.dirty_commit = true; break;
         case 'L': n.bad_lease = true; break;
         default:
             fprintf(stderr,
@@ -1474,15 +1520,36 @@ int main(int argc, char **argv) {
                     "[-x (no-fsync control)] [-N (no-durable)] "
                     "[-B (split-brain control)] "
                     "[-D (no-dedup control)] "
+                    "[-R (dirty-commit control)] "
                     "[-T (buggy-txn control)] "
                     "[-L (bad-lease control)]\n",
                     argv[0]);
             return 2;
         }
     }
+    /* "-n" entries are "port" (localhost) or "host:port" — the
+     * multi-host form the provisioning layer (harness/provision.py)
+     * uses; the reference cluster runs on machines m1..m5
+     * (scripts/setvars:7) */
     for (const char *p = peers.c_str(); *p != 0;) {
-        n.ports.push_back(atoi(p));
         const char *comma = strchr(p, ',');
+        std::string entry(p, comma ? (size_t)(comma - p)
+                                   : strlen(p));
+        size_t colon = entry.rfind(':');
+        if (colon == std::string::npos) {
+            n.hosts.push_back("127.0.0.1");
+            n.ports.push_back(atoi(entry.c_str()));
+        } else {
+            std::string resolved =
+                resolve_host(entry.substr(0, colon));
+            if (resolved.empty()) {
+                fprintf(stderr, "sut_node: cannot resolve %s\n",
+                        entry.c_str());
+                return 2;
+            }
+            n.hosts.push_back(resolved);
+            n.ports.push_back(atoi(entry.c_str() + colon + 1));
+        }
         if (comma == nullptr) break;
         p = comma + 1;
     }
@@ -1579,7 +1646,11 @@ int main(int argc, char **argv) {
     setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    bool all_local = true;
+    for (const std::string &h : n.hosts)
+        if (h != "127.0.0.1" && h != "localhost") all_local = false;
+    addr.sin_addr.s_addr = htonl(all_local ? INADDR_LOOPBACK
+                                           : INADDR_ANY);
     addr.sin_port = htons((uint16_t)n.ports[n.id]);
     if (bind(srv, (sockaddr *)&addr, sizeof addr) != 0 ||
         listen(srv, 64) != 0) {

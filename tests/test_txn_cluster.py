@@ -311,3 +311,181 @@ def test_g2_over_cluster_valid(tmp_path):
         assert res["legal-count"] >= 5, res
     finally:
         _kill(procs)
+
+
+# --- dirty reads over the cluster (round-3 VERDICT #5) ----------------------
+#
+# Before this round DirtyReadsClient only ever drove the in-memory
+# MemConn backend (workloads/comdb2.py:213-274); the cluster had the
+# txn verbs all along. -R (dirty-commit) is the matching negative
+# control: a validation conflict still applies the txn but reports
+# FAIL — the effects-misclassification bug the reference's dirty-reads
+# test exists to catch (a failed write's value visible,
+# comdb2/core.clj:492-523).
+
+from comdb2_tpu.checker.workloads import dirty_reads_checker
+from comdb2_tpu.checker.checkers import counter as counter_checker
+from comdb2_tpu.workloads.tcp import (CounterTcpClient,
+                                      DirtyReadsTcpClient)
+
+
+def test_dirty_reads_over_cluster_valid(tmp_path):
+    """Correct cluster: no failed write's value is ever read, and all
+    committed reads are uniform (OCC validation aborts torn reads)."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=500)
+    try:
+        t = fake.noop_test()
+        t.update({
+            "nodes": [], "concurrency": 5, "name": "dirty-cluster",
+            "store-root": str(tmp_path / "store"),
+            "client": DirtyReadsTcpClient(ports, n=4, timeout_s=0.6),
+            "model": None,
+            "generator": G.clients(G.time_limit(4.0, G.stagger(
+                0.01, G.mix([W.dirty_reads_read, W._DirtyWrites()])))),
+            "checker": dirty_reads_checker,
+        })
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid?"] is True, res
+        assert res["inconsistent-reads"] == [], res
+        reads = [op for op in result["history"]
+                 if op.type == "ok" and op.f == "read"]
+        assert len(reads) >= 10, len(reads)
+    finally:
+        _kill(procs)
+
+
+def test_dirty_reads_dirty_commit_control_detected():
+    """-R end to end, deterministic interleaving: writer W2 conflicts
+    with W1, the server applies W2's rows anyway and reports FAIL; a
+    read then observes the failed write's value — the checker must
+    flag it."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800,
+                          flags=["-R"])
+    conn = _conn(ports[0])
+    try:
+        base, n = 10_000, 3
+        init = ClusterTxn(conn)
+        init.begin()
+        for i in range(n):
+            init.write(base + i, -1)
+        assert init.commit() == "ok"
+
+        t1 = ClusterTxn(conn)
+        t1.begin()
+        t2 = ClusterTxn(conn)
+        t2.begin()
+        for i in range(n):
+            t1.read(base + i)
+            t2.read(base + i)
+        for i in range(n):
+            t1.write(base + i, 7)
+            t2.write(base + i, 8)
+        assert t1.commit() == "ok"
+        second = t2.commit()
+        assert second == "fail"          # the lie: it actually applied
+
+        rd = ClusterTxn(conn)
+        rd.begin()
+        seen = tuple(rd.read(base + i) for i in range(n))
+        rd.commit()
+        assert seen == (8, 8, 8), seen   # failed write visible
+
+        history = [
+            Op(process=0, type="invoke", f="write", value=7, time=0),
+            Op(process=0, type="ok", f="write", value=7, time=1),
+            Op(process=1, type="invoke", f="write", value=8, time=2),
+            Op(process=1, type="fail", f="write", value=8, time=3),
+            Op(process=2, type="invoke", f="read", value=None, time=4),
+            Op(process=2, type="ok", f="read", value=seen, time=5),
+        ]
+        res = dirty_reads_checker.check(None, None, history)
+        assert res["valid?"] is False, res
+        assert res["dirty-reads"], res
+    finally:
+        conn.close()
+        _kill(procs)
+
+
+# --- counter over the cluster (round-3 VERDICT #5) --------------------------
+
+def _counter_add(test=None, process=None):
+    import random as _random
+
+    return {"type": "invoke", "f": "add",
+            "value": _random.randint(1, 5)}
+
+
+def _counter_read(test=None, process=None):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def test_counter_over_cluster_valid(tmp_path):
+    """checker.clj:220-272 semantics over the wire: every committed
+    read falls within [sum of acked adds at invoke, sum of attempted
+    adds at completion]."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=500)
+    try:
+        t = fake.noop_test()
+        t.update({
+            "nodes": [], "concurrency": 5, "name": "counter-cluster",
+            "store-root": str(tmp_path / "store"),
+            "client": CounterTcpClient(ports, timeout_s=0.6),
+            "model": None,
+            "generator": G.clients(G.time_limit(4.0, G.stagger(
+                0.01, G.mix([_counter_add, _counter_read])))),
+            "checker": counter_checker,
+        })
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid?"] is True, res
+        assert len(res["reads"]) >= 10, res
+        adds = [op for op in result["history"]
+                if op.type == "ok" and op.f == "add"]
+        assert len(adds) >= 10, len(adds)
+    finally:
+        _kill(procs)
+
+
+def test_counter_buggy_txn_lost_update_detected():
+    """-T end to end, deterministic: two adds read the same snapshot,
+    both commit (no validation), one increment is lost; a later read
+    sits below the checker's lower bound."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800,
+                          flags=["-T"])
+    conn = _conn(ports[0])
+    try:
+        key = CounterTcpClient.KEY
+        t1 = ClusterTxn(conn)
+        t1.begin()
+        a = t1.read(key) or 0
+        t2 = ClusterTxn(conn)
+        t2.begin()
+        b = t2.read(key) or 0
+        t1.write(key, a + 5)
+        t2.write(key, b + 5)
+        assert t1.commit() == "ok"
+        assert t2.commit() == "ok"       # -T: lost update commits
+        rd = ClusterTxn(conn)
+        rd.begin()
+        v = rd.read(key)
+        rd.commit()
+        assert v == 5, v                 # one add lost
+
+        history = [
+            Op(process=0, type="invoke", f="add", value=5, time=0),
+            Op(process=0, type="ok", f="add", value=5, time=1),
+            Op(process=1, type="invoke", f="add", value=5, time=2),
+            Op(process=1, type="ok", f="add", value=5, time=3),
+            Op(process=2, type="invoke", f="read", value=None, time=4),
+            Op(process=2, type="ok", f="read", value=v, time=5),
+        ]
+        res = counter_checker.check(None, None, history)
+        assert res["valid?"] is False, res
+    finally:
+        conn.close()
+        _kill(procs)
