@@ -1,0 +1,118 @@
+"""The reference's headline test, end to end: ``register-test-nemesis``
+is the ONE active deftest in the vendored suite
+(``jepsen/test/comdb2/core_test.clj:38-39`` — assert
+``(:valid? (:results (jepsen/run! ...)))``), run by ``jepsenloop.sh``
+forever on a healed cluster. This is its full in-tree analog:
+
+  provision (SutNodeDB) → 5-node replicated cluster → register workload
+  at concurrency 10 ([w cas cas r], core.clj:567-613) with the
+  master+1 breaknet nemesis cycling → history → independent-keyed
+  linearizable check on the DEVICE engines → perf/timeline artifacts —
+  and the verdict must be VALID.
+"""
+
+import os
+import socket
+
+import pytest
+
+from comdb2_tpu.control.remote import LocalRemote
+from comdb2_tpu.harness import core
+from comdb2_tpu.harness import generator as G
+from comdb2_tpu.harness.provision import SutNodeDB, local_layout
+from comdb2_tpu.workloads import comdb2 as W
+from comdb2_tpu.workloads.tcp import (ClusterControl,
+                                      ClusterPartitioner,
+                                      TcpClusterRegisterClient)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(ROOT, "native", "build", "sut_node")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(BINARY),
+                                reason="sut_node not built")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_register_tester_nemesis_end_to_end(tmp_path):
+    nodes = ["m1", "m2", "m3", "m4", "m5"]     # the reference's fleet
+    ports = _free_ports(5)
+    db = SutNodeDB(LocalRemote(), BINARY, local_layout(nodes, ports),
+                   base_dir=str(tmp_path / "sut"), timeout_ms=300,
+                   elect_ms=500, lease_ms=300)
+    ctl = ClusterControl(ports)
+    # the linearizable check runs the HOST engine here: the history's
+    # process width varies run to run (partition-window retirements),
+    # so the device path would compile a fresh program every run
+    # (CLAUDE.md: per-seed shapes recompile). Device-engine
+    # correctness has its own coverage (wide-P host cross-checks,
+    # interpret parity, the TPU fuzz); this test is the full
+    # provision→cluster→nemesis→verdict loop.
+    from comdb2_tpu.checker import checkers as C
+    from comdb2_tpu.checker import independent as I
+    from comdb2_tpu.report import Timeline, perf_checker
+
+    checker = C.compose({
+        "perf": perf_checker(),
+        "timeline": Timeline(),
+        "linearizable": I.checker(
+            C.Linearizable(host_threshold=1 << 20)),
+    })
+    # the reference cycle is 10 s on / 10 s off over 300 s; compress to
+    # two ~1.2 s partition windows in a ~6 s run so CI stays fast while
+    # the history still spans faults and failovers
+    nemesis_steps = [G.sleep(0.8), {"type": "info", "f": "start"},
+                     G.sleep(1.0), {"type": "info", "f": "stop"},
+                     G.sleep(0.8), {"type": "info", "f": "start"},
+                     G.sleep(1.0), {"type": "info", "f": "stop"}]
+    # generous client timeout + retry budget = the reference's
+    # ``set max_retries 100000`` (core.clj:92): indeterminate ops stay
+    # rare, so the checker's pending set — every :info pends forever —
+    # stays searchable (a stingy 0.5 s/3-retry client turned this
+    # history into a >4M-config closure)
+    t = W.register_tester_nemesis(opts={
+        "nodes": nodes,
+        "db": db,
+        "store-root": str(tmp_path / "store"),
+        "client": TcpClusterRegisterClient(ports, timeout_s=1.0,
+                                           mutate_retries=8),
+        "nemesis": ClusterPartitioner(ctl, isolate_primary=True),
+        "checker": checker,
+        "generator": G.phases(
+            G.nemesis(
+                G.seq(nemesis_steps),
+                G.time_limit(6.0, G.stagger(0.02, G.clients(
+                    G.mix([W.w, W.cas, W.cas, W.r]))))),
+            G.log("quiesce"),
+            G.sleep(1.0)),
+    })
+    result = core.run(t)
+    ctl.heal()
+    res = result["results"]
+    assert res["valid?"] is True, res
+    assert res["linearizable"]["valid?"] is True, res["linearizable"]
+    history = result["history"]
+    oks = [op for op in history
+           if op.type == "ok" and op.process != "nemesis"]
+    infos = [op for op in history
+             if op.type == "info" and op.process != "nemesis"]
+    # the run must have real throughput AND really have been hurt by
+    # the partitions (indeterminate ops / retired processes), like the
+    # reference's nemesis runs
+    assert len(oks) >= 80, len(oks)
+    starts = [op for op in history
+              if op.process == "nemesis" and op.f == "start"]
+    assert len(starts) >= 2, "nemesis never fired"
+    # perf/timeline artifacts rendered alongside the verdict
+    assert res["perf"]["valid?"] is True
+    assert res["timeline"]["valid?"] is True
