@@ -67,7 +67,10 @@ extern "C" {
 
 sut_handle *sut_open(const char *target, uint32_t flags, unsigned seed) {
     auto *h = new sut_handle(flags, seed);
-    if (target != nullptr && strchr(target, ':') != nullptr) {
+    /* "@file[#dbname]" = comdb2db-style discovery (sut_tcp.cpp);
+     * "host:port,..." = explicit node list; NULL/other = in-memory */
+    if (target != nullptr &&
+        (target[0] == '@' || strchr(target, ':') != nullptr)) {
         h->tcp = sut_tcp_open(target, seed);
         if (h->tcp == nullptr) {
             delete h;

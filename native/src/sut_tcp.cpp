@@ -141,7 +141,50 @@ int read_op(sut_tcp *t, const std::string &line, char *reply, int cap) {
 
 extern "C" {
 
+/* comdb2db-style cluster discovery (the role of cdb2api's comdb2db
+ * config lookup, cdb2api.c:780-1000): "@<path>[#<dbname>]" names a
+ * config file whose lines are "<dbname> host:port host:port ..."
+ * ('#' comments). With no #dbname the first entry wins. Returns the
+ * flattened "host:port,host:port" list, or "" when the file/db is
+ * missing. */
+static std::string resolve_comdb2db(const char *spec) {
+    std::string s(spec + 1);            /* past '@' */
+    std::string want;
+    size_t hash = s.rfind('#');
+    if (hash != std::string::npos) {
+        want = s.substr(hash + 1);
+        s = s.substr(0, hash);
+    }
+    FILE *f = fopen(s.c_str(), "r");
+    if (f == nullptr) return "";
+    char line[1024];
+    std::string out;
+    while (fgets(line, sizeof line, f) != nullptr) {
+        char *p = line;
+        while (*p == ' ' || *p == '\t') p++;
+        if (*p == '#' || *p == '\n' || *p == 0) continue;
+        char name[256] = {0};
+        int off = 0;
+        if (sscanf(p, "%255s %n", name, &off) < 1) continue;
+        if (!want.empty() && want != name) continue;
+        for (char *tok = strtok(p + off, " \t\r\n"); tok != nullptr;
+             tok = strtok(nullptr, " \t\r\n")) {
+            if (!out.empty()) out += ",";
+            out += tok;
+        }
+        break;
+    }
+    fclose(f);
+    return out;
+}
+
 sut_tcp *sut_tcp_open(const char *target, unsigned seed) {
+    std::string resolved;
+    if (target != nullptr && target[0] == '@') {
+        resolved = resolve_comdb2db(target);
+        if (resolved.empty()) return nullptr;
+        target = resolved.c_str();
+    }
     auto *t = new sut_tcp();
     t->rng.seed(seed);
     std::string s(target);

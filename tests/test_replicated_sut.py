@@ -721,3 +721,49 @@ def test_five_node_cluster_breaknet_failover(tmp_path):
         assert ctl.await_replicated(timeout_s=10.0), ctl.info()
     finally:
         _kill(procs)
+
+
+def test_ha_client_comdb2db_discovery(tmp_path):
+    """cdb2api-style cluster discovery (cdb2api.c:780-1000): the HA
+    client resolves "@<cfgfile>#<dbname>" to the node list from a
+    comdb2db-format config instead of taking hosts on the command
+    line; the workload then runs normally over the discovered
+    cluster. A missing dbname must fail fast, not fall back to the
+    in-memory store."""
+    import subprocess
+
+    from comdb2_tpu.checker import analysis
+    from comdb2_tpu.models.model import cas_register
+    from comdb2_tpu.ops.history import parse_history
+
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=400,
+                          elect_ms=500, lease_ms=300)
+    cfg = tmp_path / "comdb2db.cfg"
+    cfg.write_text(
+        "# comdb2db-style cluster config\n"
+        "otherdb 10.0.0.1:1 10.0.0.2:1\n"
+        + "testdb " + " ".join(f"127.0.0.1:{p}" for p in ports) + "\n")
+    out = tmp_path / "disc.edn"
+    try:
+        p = subprocess.run(
+            [os.path.join(ROOT, "native", "build", "ct_register"),
+             "-T", "3", "-r", "6", "-d", f"@{cfg}#testdb",
+             "-j", str(out), "-s", "5"],
+            capture_output=True, text=True, timeout=60)
+        assert p.returncode == 0, p.stderr
+        h = parse_history(out.read_text())
+        oks = sum(1 for op in h if op.type == "ok")
+        assert oks >= 20, oks
+        a = analysis(cas_register(), h, backend="host")
+        assert a.valid is True
+        # unknown dbname: the driver must fail, not silently run
+        # against nothing
+        p2 = subprocess.run(
+            [os.path.join(ROOT, "native", "build", "ct_register"),
+             "-T", "1", "-r", "2", "-d", f"@{cfg}#nosuchdb",
+             "-j", str(tmp_path / "x.edn")],
+            capture_output=True, text=True, timeout=30)
+        assert p2.returncode != 0
+    finally:
+        _kill(procs)
