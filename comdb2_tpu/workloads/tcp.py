@@ -144,18 +144,26 @@ class TcpClusterRegisterClient(TcpRegisterClient):
         self._seq = 0
         self._port_ix = 0
 
+    def _clone(self):
+        return TcpClusterRegisterClient(self.ports, self.timeout_s,
+                                        self.mutate_retries)
+
+    def _post_connect(self) -> None:
+        """Per-connection preamble hook (the SQL client sends its
+        session SETs here)."""
+
     def setup(self, test, node):
         import random as _random
 
         port_ix = self._next % len(self.ports)
         self._next += 1
-        c = TcpClusterRegisterClient(self.ports, self.timeout_s,
-                                     self.mutate_retries)
+        c = self._clone()
         c._port_ix = port_ix
         c._session = _random.SystemRandom().getrandbits(32)
         c.conn = SutConnection(self.host, self.ports[port_ix],
                                self.timeout_s)
         c.conn.connect()
+        c._post_connect()
         return c
 
     def _rotate(self) -> None:
@@ -539,6 +547,24 @@ class ClusterTxn:
         self.conn = conn
         self.txid: Optional[int] = None
 
+    # request-line builders + success token: the SQL-surface txn
+    # (:mod:`.sql`) overrides ONLY these; reply parsing is shared so
+    # the two surfaces cannot silently disagree on shapes
+    _dml_ok = "OK"
+
+    def _q_read(self, key: int) -> str:
+        return f"TR {self.txid} {key}"
+
+    def _q_predicate(self, table: str, key: int) -> str:
+        return f"TP {self.txid} {table} {key}"
+
+    def _q_write(self, key: int, val: int) -> str:
+        return f"TW {self.txid} {key} {val}"
+
+    def _q_insert(self, table: str, key: int, rid: int,
+                  val: int) -> str:
+        return f"TI {self.txid} {table} {key} {rid} {val}"
+
     def begin(self) -> None:
         reply = self.conn.request("TB")
         if not reply.startswith("T "):
@@ -546,7 +572,7 @@ class ClusterTxn:
         self.txid = int(reply[2:])
 
     def read(self, key: int) -> Optional[int]:
-        reply = self.conn.request(f"TR {self.txid} {key}")
+        reply = self.conn.request(self._q_read(key))
         if reply == "NIL":
             return None
         if reply.startswith("V "):
@@ -555,7 +581,7 @@ class ClusterTxn:
 
     def predicate(self, table: str, key: int):
         """All committed rows of (table, key) as [(id, value)]."""
-        reply = self.conn.request(f"TP {self.txid} {table} {key}")
+        reply = self.conn.request(self._q_predicate(table, key))
         if not reply.startswith("V"):
             raise TxnAborted(f"predicate failed: {reply}")
         rows = []
@@ -565,14 +591,13 @@ class ClusterTxn:
         return rows
 
     def write(self, key: int, val: int) -> None:
-        reply = self.conn.request(f"TW {self.txid} {key} {val}")
-        if reply != "OK":
+        reply = self.conn.request(self._q_write(key, val))
+        if reply != self._dml_ok:
             raise TxnAborted(f"write failed: {reply}")
 
     def insert(self, table: str, key: int, rid: int, val: int) -> None:
-        reply = self.conn.request(
-            f"TI {self.txid} {table} {key} {rid} {val}")
-        if reply != "OK":
+        reply = self.conn.request(self._q_insert(table, key, rid, val))
+        if reply != self._dml_ok:
             raise TxnAborted(f"insert failed: {reply}")
 
     def commit(self, nonce: int = 0) -> str:
@@ -633,10 +658,15 @@ class _ClusterTxnClientBase(client_ns.Client):
         self._seq += 1
         return (self._session << 24) | self._seq
 
+    def _make_txn(self):
+        """Txn factory — the SQL-surface clients (:mod:`.sql`) swap in
+        a text-statement txn here; everything else is shared."""
+        return ClusterTxn(self.conn)
+
     def _run_txn(self, op, body, read_only=False):
         """Run ``body(txn)`` in one wire txn; body returns the ``ok``
         completion (or a full completion dict to use verbatim)."""
-        txn = ClusterTxn(self.conn)
+        txn = self._make_txn()
         try:
             txn.begin()
             out = body(txn)

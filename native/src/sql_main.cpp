@@ -1,0 +1,152 @@
+/* ct_sql — minimal interactive SQL shell against a sut_node cluster
+ * (the cdb2sql role, tools/cdb2sql in the reference).
+ *
+ * Usage:
+ *   ct_sql host:port[,host:port...] [-c "sql"]... [-t timeout_ms]
+ *
+ * With -c, runs each statement and exits (exit 1 on ERR/FAIL/UNKNOWN
+ * in any reply); otherwise reads one statement per line from stdin
+ * and prints the server's reply. The server parses the SQL
+ * (sql_front.cpp) — this shell is wire-dumb on purpose: implementation
+ * diversity against the Python clients ends at the socket.
+ *
+ * Connects to the FIRST reachable node of the list and sticks to it
+ * (a SQL session is per-connection: an open transaction cannot move
+ * nodes — same constraint as a cdb2 appsock session).
+ */
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+int dial(const std::string &host, int port, int timeout_ms) {
+    struct addrinfo hints = {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo *res = nullptr;
+    char portbuf[16];
+    snprintf(portbuf, sizeof portbuf, "%d", port);
+    if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0)
+        return -1;
+    int fd = socket(res->ai_family, res->ai_socktype, 0);
+    if (fd >= 0) {
+        struct timeval tv = {timeout_ms / 1000,
+                             (timeout_ms % 1000) * 1000};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+            close(fd);
+            fd = -1;
+        }
+    }
+    freeaddrinfo(res);
+    return fd;
+}
+
+/* one request line -> one reply line; empty string = dead link */
+std::string request(int fd, const std::string &line) {
+    std::string out = line + "\n";
+    size_t off = 0;
+    while (off < out.size()) {
+        ssize_t w = send(fd, out.data() + off, out.size() - off, 0);
+        if (w <= 0) return "";
+        off += (size_t)w;
+    }
+    std::string reply;
+    char c;
+    for (;;) {
+        ssize_t r = recv(fd, &c, 1, 0);
+        if (r <= 0) return "";       /* truncated reply = indeterminate */
+        if (c == '\n') return reply;
+        reply += c;
+    }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr,
+                "usage: %s host:port[,host:port...] [-c sql]... "
+                "[-t timeout_ms]\n",
+                argv[0]);
+        return 2;
+    }
+    std::vector<std::string> stmts;
+    int timeout_ms = 2000;
+    for (int i = 2; i < argc; ++i) {
+        if (strcmp(argv[i], "-c") == 0 && i + 1 < argc)
+            stmts.push_back(argv[++i]);
+        else if (strcmp(argv[i], "-t") == 0 && i + 1 < argc)
+            timeout_ms = atoi(argv[++i]);
+    }
+
+    /* first reachable node of the comma list */
+    int fd = -1;
+    std::string list = argv[1];
+    size_t pos = 0;
+    while (fd < 0 && pos != std::string::npos) {
+        size_t comma = list.find(',', pos);
+        std::string hp = list.substr(
+            pos, comma == std::string::npos ? std::string::npos
+                                            : comma - pos);
+        pos = comma == std::string::npos ? std::string::npos : comma + 1;
+        size_t colon = hp.rfind(':');
+        if (colon == std::string::npos) continue;
+        fd = dial(hp.substr(0, colon), atoi(hp.c_str() + colon + 1),
+                  timeout_ms);
+    }
+    if (fd < 0) {
+        fprintf(stderr, "ct_sql: no node reachable\n");
+        return 2;
+    }
+
+    int rc = 0;
+    if (!stmts.empty()) {
+        for (const std::string &s : stmts) {
+            std::string r = request(fd, s);
+            if (r.empty()) {
+                /* timeout/short write: a late reply would desync the
+                 * line protocol and later statements would read the
+                 * wrong answers — stop, like the interactive loop */
+                printf("UNKNOWN\n");
+                rc = 1;
+                break;
+            }
+            printf("%s\n", r.c_str());
+            if (r.rfind("ERR", 0) == 0 || r == "FAIL" || r == "UNKNOWN")
+                rc = 1;
+        }
+    } else {
+        char *line = nullptr;
+        size_t cap = 0;
+        ssize_t len;
+        while ((len = getline(&line, &cap, stdin)) != -1) {
+            while (len > 0 &&
+                   (line[len - 1] == '\n' || line[len - 1] == '\r'))
+                line[--len] = 0;
+            if (len == 0) continue;
+            std::string r = request(fd, std::string(line, (size_t)len));
+            if (r.empty()) {
+                printf("UNKNOWN\n");
+                break;               /* link died; session state gone */
+            }
+            printf("%s\n", r.c_str());
+            fflush(stdout);
+        }
+        free(line);
+    }
+    close(fd);
+    return rc;
+}

@@ -84,7 +84,16 @@
  * Mutation replies carry the commit LSN so HA clients can fold their
  * own acknowledged writes into the snapshot-LSN gate (the cdb2api
  * snapshot_file/snapshot_lsn role, cdb2api.c:618-656).
+ *
+ * SQL text surface: any line whose first word is a SQL keyword
+ * (SELECT/INSERT/UPDATE/BEGIN/COMMIT/ROLLBACK/SET) is parsed
+ * per-connection into these verbs by sql_front.cpp — the
+ * dispatch_sql_query role (db/sqlinterfaces.c:5970); grammar and
+ * reply shapes documented in comdb2_tpu/sql_front.h. The ct_sql
+ * mini-shell (sql_main.cpp) drives it interactively.
  */
+#include "comdb2_tpu/sql_front.h"
+
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -1471,6 +1480,9 @@ void serve_conn(int fd) {
         close(fd);
         return;
     }
+    /* SQL session state lives per connection, like a cdb2 appsock
+     * thread's (db/sqlinterfaces.c:5768 sqlengine_work_appsock) */
+    sqlfront::Session sql;
     /* dynamic line buffer: a replicated 'T' entry's E line grows with
      * its sub-ops (~5KB+ at the 512-sub-op admission cap). A fixed
      * fgets buffer would split it, parse the tail as ERR, and wedge
@@ -1482,9 +1494,19 @@ void serve_conn(int fd) {
         if (len > 32 * 1024 * 1024) break;  /* same cap as read_line */
         while (len > 0 && (line[len - 1] == '\n' || line[len - 1] == '\r'))
             line[--len] = 0;
-        std::string out = handle(std::string(line, (size_t)len)) + "\n";
+        std::string req(line, (size_t)len);
+        std::string out =
+            (sqlfront::is_statement(req)
+                 ? sqlfront::execute(req, sql, [](const std::string &v) {
+                       return handle(v);
+                   })
+                 : handle(req)) +
+            "\n";
         if (!send_all(fd, out)) break;
     }
+    /* a dropped connection aborts its open SQL txn (comdb2 does the
+     * same for an appsock that dies mid-txn) */
+    if (sql.txid >= 0) handle("TA " + std::to_string(sql.txid));
     free(line);
     fclose(in);
 }
