@@ -60,7 +60,7 @@ def _median(n_ops: int, dts) -> float:
 # r1/r2 predate the spread fields so they carry the then-reported
 # value (best-of-N — labeled, not silently mixed)
 TREND_50K = {"r1_best": 85226.6, "r2_best": 80267.5,
-             "r3_median": 70559.3}
+             "r3_median": 70559.3, "r4_median": 63616.2}
 
 
 def main() -> None:
@@ -192,10 +192,13 @@ def _bench_batch_4096() -> None:
 
 def _run_bench_p10() -> None:
     """The reference register test's concurrency (10 threads,
-    comdb2/core.clj:567-613) at the 50k-op scale, served by the fused
-    kernel's (16,128)/3-word tier (round-3 VERDICT #2). max_pending
-    bounds in-flight depth the way a real cluster's ms-scale
-    completions do."""
+    comdb2/core.clj:567-613) at the 50k-op scale. Slot renaming
+    (``remap_slots``, round 5) maps the 10 process ids onto the
+    history's max concurrent open calls (max_pending 5 -> 5 slots), so
+    this runs the fused kernel's fast (8,128)/2-word tier instead of
+    the (16,128)/3-word one that previously made p10 ~30% slower than
+    p5 (round-4 Weak #4). max_pending bounds in-flight depth the way a
+    real cluster's ms-scale completions do."""
     import random as _random
 
     import jax
@@ -218,8 +221,11 @@ def _run_bench_p10() -> None:
     packed = pack_history(history)
     n_ops = sum(1 for op in history if op.type == "invoke")
     mm = make_memo(cas_register(), packed)
-    segs = LJ.make_segments(packed)
-    P = len(packed.process_table)
+    # production slot renaming (linear._analyze_device does the same):
+    # 10 processes, <=5 concurrent open calls -> 5 slots, even-bucketed
+    # to 6 -> the (8,128)/2-word kernel tier
+    segs, P_eff = LJ.remap_slots(LJ.make_segments(packed))
+    P = max(P_eff + (P_eff & 1), 2)
     sizes = dict(n_states=mm.n_states, n_transitions=mm.n_transitions)
     engine = {"e": None}
     use_fused = PSEG.available()
@@ -249,12 +255,20 @@ def _run_bench_p10() -> None:
         run()
         dts.append(time.perf_counter() - t0)
     ops_s = _median(n_ops, dts)
+    # mean closure depth: the kernel's per-segment cost is ~linear in
+    # the pending count, and this history's is ~24% deeper than the
+    # p5 one's (3.68 vs 2.96) — the residual p10-vs-p5 gap is that
+    # workload depth, not tier overhead (both run the same
+    # (8,128)/2-word tier since slot renaming)
+    d = segs.depth[segs.ok_proc >= 0]
     print(json.dumps({
         "metric": "linear_check_ops_per_s_50k_p10",
         "value": round(ops_s, 1),
         "unit": "ops/s",
         "vs_baseline": round(ops_s / BASELINE_OPS_S, 2),
         "engine": engine["e"],
+        "effective_slots": P_eff,
+        "mean_closure_depth": round(float(d.mean()), 3),
         **_spread(n_ops, dts),
     }))
 
@@ -278,13 +292,14 @@ def _run_bench() -> None:
     n_ops = sum(1 for op in history if op.type == "invoke")
     mm = make_memo(cas_register(), packed)
     succ = LJ.pad_succ(mm.succ, 64, 64)
-    segs = LJ.make_segments(packed)
-    # the production even-bucketed slot width (see linear._analyze_device)
-    # and the production engines: the fused Pallas kernel (the whole
-    # segment loop in one kernel per 1024-segment chunk, F=128) with
-    # the adaptive two-tier XLA engine as fallback. F=128 covers this
-    # history's measured worst segment (88 configs).
-    F, Fs, P = 128, 32, N_PROCS + (N_PROCS & 1)
+    # production slot renaming + even-bucketed slot width (see
+    # linear._analyze_device) and the production engines: the fused
+    # Pallas kernel (the whole segment loop in one kernel per
+    # 1024-segment chunk, F=128) with the adaptive two-tier XLA engine
+    # as fallback. F=128 covers this history's measured worst segment
+    # (88 configs).
+    segs, P_eff = LJ.remap_slots(LJ.make_segments(packed))
+    F, Fs, P = 128, 32, max(P_eff + (P_eff & 1), 2)
     sizes = dict(n_states=mm.n_states, n_transitions=mm.n_transitions)
 
     from comdb2_tpu.checker import pallas_seg as PSEG
@@ -323,13 +338,16 @@ def _run_bench() -> None:
         dts.append(time.perf_counter() - t0)
 
     ops_s = _median(n_ops, dts)
-    trend = dict(TREND_50K, r4_median=round(ops_s, 1))
+    trend = dict(TREND_50K, r5_median=round(ops_s, 1))
+    d = segs.depth[segs.ok_proc >= 0]
     print(json.dumps({
         "metric": "linear_check_ops_per_s_50k",
         "value": round(ops_s, 1),
         "unit": "ops/s",
         "vs_baseline": round(ops_s / BASELINE_OPS_S, 2),
         "engine": engine["e"],
+        "effective_slots": P_eff,
+        "mean_closure_depth": round(float(d.mean()), 3),
         "trend": trend,
         **_spread(n_ops, dts),
     }))

@@ -154,13 +154,20 @@ class SegmentBatch:
     depth: np.ndarray      # int32[S] — max pending depth across lanes
 
 
-def segment_batch(batch: PackedBatch) -> SegmentBatch:
+def segment_batch(batch: PackedBatch,
+                  streams: Optional[list] = None) -> SegmentBatch:
     """Compile each history's per-ok segments (union transition ids),
     padded to a common (S, K). Malformed histories (double-pending
     process) get an empty stream; ``check_batch`` reports them
-    ``unknown``."""
-    segss = [_empty_stream() if _malformed(p) else LJ.make_segments(p)
-             for p in batch.packeds]
+    ``unknown``. ``streams``: per-history SegmentStreams already
+    union-remapped (and possibly slot-renamed — a pure relabeling the
+    XLA engines accept unchanged), e.g. from ``_stream_segments`` when
+    the kernel path rejected the batch — reusing them skips a second
+    O(total-ops) host segment pass."""
+    prebuilt = streams is not None
+    segss = streams if prebuilt else [
+        _empty_stream() if _malformed(p) else LJ.make_segments(p)
+        for p in batch.packeds]
     S = _next_pow2(max((s.ok_proc.shape[0] for s in segss), default=1))
     K = _next_pow2(max((s.inv_proc.shape[1] for s in segss),
                        default=1), 2)
@@ -171,7 +178,7 @@ def segment_batch(batch: PackedBatch) -> SegmentBatch:
                           constant_values=-1)
         tr = np.pad(s.inv_tr, ((0, ds), (0, dk)))
         mask = inv_proc >= 0
-        if remap.size:
+        if remap.size and not prebuilt:
             tr[mask] = remap[tr[mask]]
         ips.append(inv_proc)
         its.append(tr)
@@ -189,10 +196,16 @@ def segment_batch(batch: PackedBatch) -> SegmentBatch:
 
 def _stream_segments(batch: PackedBatch):
     """Per-history SegmentStreams with transition ids remapped into the
-    union table (the streamed kernel shares ONE table). Malformed
-    histories get an empty stream; ``check_batch`` reports them
-    ``unknown``."""
+    union table (the streamed kernel shares ONE table) and process ids
+    renamed to minimal reusable slots (:func:`~.linear_jax.remap_slots`
+    — the kernel's slot axis then scales with each history's max
+    concurrent open calls, not its process count). Malformed histories
+    get an empty stream; ``check_batch`` reports them ``unknown``.
+    Returns ``(streams, P_eff)`` with ``P_eff`` the max effective slot
+    count over the batch (the spec the ONE shared kernel compiles for).
+    """
     out = []
+    p_eff = 1
     for i, p in enumerate(batch.packeds):
         s = _empty_stream() if _malformed(p) else LJ.make_segments(p)
         remap = np.asarray(batch.remaps[i], np.int32)
@@ -201,9 +214,11 @@ def _stream_segments(batch: PackedBatch):
                               0).astype(np.int32)
         else:  # no successful invokes anywhere: nothing to remap
             inv_tr = np.zeros_like(s.inv_tr, np.int32)
-        out.append(LJ.SegmentStream(s.inv_proc, inv_tr, s.ok_proc,
-                                    s.seg_index, s.depth))
-    return out
+        s2, pe = LJ.remap_slots(LJ.SegmentStream(
+            s.inv_proc, inv_tr, s.ok_proc, s.seg_index, s.depth))
+        p_eff = max(p_eff, pe)
+        out.append(s2)
+    return out, p_eff
 
 
 def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
@@ -255,7 +270,6 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
     B = len(batch)
     sizes = {"n_states": batch.memo.n_states,
              "n_transitions": batch.memo.n_transitions}
-    P_k = batch.P           # the kernel has no pow2 slot requirement
     D = int(mesh.shape[batch_axis]) if mesh is not None else 1
     B_pad = -(-B // D) * D  # sharded engines need D | B
 
@@ -279,24 +293,29 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
         return "vmap"
 
     def stream_fits():
-        # gate on the spec BEFORE the O(total-ops) segment pass so an
-        # ineligible shape doesn't do the host work twice (spec_for
-        # serves P <= 15 since the (16,128)/3-word tier)
+        # gate BEFORE the O(total-ops) segment pass so a shape that
+        # can never run fused (table too big, K too wide — checked at
+        # P=1, the minimum) skips the host work. P itself is NOT final
+        # here: slot renaming in _stream_segments can shrink it below
+        # the tier bound, so P-ineligible shapes still try the pass
+        # when everything else fits.
         return (PSEG.spec_for(sizes["n_states"],
-                              sizes["n_transitions"], P_k, 8)
+                              sizes["n_transitions"], 1, 8)
                 is not None and PSEG.available())
 
     if engine == "auto":
         engine = "stream" if stream_fits() else pick_xla_engine()
-    if engine == "stream":
+    prebuilt_streams = None      # reused by keys/flat when the kernel
+    if engine == "stream":       # path rejects an already-built batch
         rs = None
         if stream_fits():
-            segs_list = _stream_segments(batch)
+            segs_list, P_stream = _stream_segments(batch)
+            prebuilt_streams = segs_list
             devices = (list(mesh.devices.flat)
                        if mesh is not None else None)
             rs = PSEG.check_device_pallas_stream(
-                batch.memo.succ, segs_list, P=P_k, devices=devices,
-                **sizes)
+                batch.memo.succ, segs_list, P=P_stream,
+                devices=devices, **sizes)
         if rs is not None:
             note("stream" if mesh is None else "stream-sharded")
             status = np.array([r[0] for r in rs], np.int32)
@@ -339,7 +358,7 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
         engine = pick_xla_engine()
     if engine in ("keys", "flat"):
         note(engine if mesh is None else engine + "-sharded")
-        sb = segment_batch(batch)
+        sb = segment_batch(batch, streams=prebuilt_streams)
         if mesh is not None:
             ip, it, op_, dp = _pad_batch_axis(sb, B_pad - B)
             status, fail_seg, n_final = LJ.check_device_keys_sharded(

@@ -49,6 +49,29 @@ class Counterexample:
     replayed_segments: int = 0         # host-replay bound (diagnostics)
 
 
+def _unmap_configs(cfgs, owners_row, P: int) -> Set[Config]:
+    """Map configs decoded in renamed-slot space (see
+    :func:`~.linear_jax.remap_slots`) back to process-indexed slots of
+    width ``P``. ``owners_row`` is the slot -> original-process map at
+    the decoded segment boundary; a non-IDLE slot (pending OR
+    linearized-but-not-returned) always has an owner there — the map
+    only frees a slot at its ok."""
+    out: Set[Config] = set()
+    for (st, sl) in cfgs:
+        slots = [IDLE] * P
+        for q, t in enumerate(sl):
+            if t == IDLE:
+                continue
+            p = int(owners_row[q]) if q < len(owners_row) else -1
+            if p < 0:
+                raise ValueError(
+                    f"occupied slot {q} has no owning process at the "
+                    "decoded boundary — owner map out of sync")
+            slots[p] = t
+        out.add((int(st), tuple(slots)))
+    return out
+
+
 def _carry_configs(carry, P: int) -> Set[Config]:
     """Decode a device seg-scan carry (states, slots, valid, ...) into
     host configs. Slot encoding is shared with the host engine
@@ -72,24 +95,29 @@ def reconstruct(mm: MemoizedModel, packed: PackedHistory,
     from . import linear_jax as LJ
 
     P = len(packed.process_table)
-    P2 = max(P + (P & 1), 2)
     sizes = {"n_states": mm.n_states, "n_transitions": mm.n_transitions}
-    # the same shape buckets as linear._analyze_device so the re-scan
-    # reuses the verdict path's compiled programs instead of compiling
-    # fresh ones per raw (S, K)
+    # the same shape buckets AND slot renaming as
+    # linear._analyze_device so the re-scan reuses the verdict path's
+    # compiled programs instead of compiling fresh ones per raw (S, K).
+    # The device frontier decodes in renamed-slot space; ``owners``
+    # (slot -> original process, per segment) maps it back before the
+    # host replay, which speaks process-indexed slots.
     segs = LJ.make_segments(packed)
     S = segs.ok_proc.shape[0]
     segs = LJ.make_segments(
         packed, s_pad=_next_pow2(S, 64),
         k_pad=_next_pow2(segs.inv_proc.shape[1], 2))
+    segs, P_eff, owners = LJ.remap_slots(segs, with_maps=True)
+    Pe = max(P_eff, 1)
+    P2 = max(Pe + (Pe & 1), 2)
 
     # fast path: the fused kernel's chunked scan (~6x the XLA engine)
     # hands back the packed boundary frontier directly
-    boundary = _pallas_boundary(mm, segs, P2 if P2 <= 7 else P, sizes)
+    boundary = _pallas_boundary(mm, segs, P2 if P2 <= 7 else Pe, sizes)
     if boundary is not None:
-        boundary_cfgs, done, fail_seg = boundary
-        boundary_cfgs = {(s, sl[:P] + (linear_host.IDLE,) * (P - len(sl)))
-                         for (s, sl) in boundary_cfgs}
+        raw_cfgs, done, fail_seg = boundary
+        boundary_cfgs = _unmap_configs(
+            raw_cfgs, owners[done - 1] if done > 0 else (), P)
     else:
         # XLA fallback: chunked seg2 scan, decode the carry
         succ = LJ.pad_succ(mm.succ, _next_pow2(mm.succ.shape[0]),
@@ -99,7 +127,6 @@ def reconstruct(mm: MemoizedModel, packed: PackedHistory,
         # readback round-trip costs ~100 ms through the tunnel
         chunk = max(_next_pow2(min(chunk, max(S, 1))), 64)
         carry = LJ.init_seg_carry(F, P2)
-        boundary_cfgs = _carry_configs(carry, P)
         done = 0
         fail_seg = -1
         while done < S:
@@ -120,10 +147,16 @@ def reconstruct(mm: MemoizedModel, packed: PackedHistory,
             if int(carry2[4]) != LJ.VALID:   # UNKNOWN: not decodable
                 return None
             carry = carry2
-            boundary_cfgs = _carry_configs(carry, P)
             done = end
         if fail_seg < 0:
             return None
+        # on the INVALID break ``carry`` still holds the last boundary
+        # BEFORE the failing chunk — one frontier readback here instead
+        # of one per chunk (each device->host round-trip is ~100 ms on
+        # the tunnel)
+        boundary_cfgs = _unmap_configs(
+            _carry_configs(carry, Pe),
+            owners[done - 1] if done > 0 else (), P)
 
     # host replay: from the history row after the boundary's last ok
     start_index = (int(segs.seg_index[done - 1]) + 1) if done > 0 else 0

@@ -136,8 +136,19 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     segs = LJ.make_segments(
         packed, s_pad=_next_pow2(s_real, 64),
         k_pad=_next_pow2(segs.inv_proc.shape[1], 2))
+    # slot renaming: processes map to a minimal pool of reusable
+    # slots, so every engine's slot axis scales with the history's
+    # max CONCURRENT open calls instead of its process count (a
+    # concurrency-10 register history with <=6 calls in flight runs
+    # the fused kernel's fast (8,128)/2-word tier; a 30-process
+    # cluster history with bounded in-flight depth becomes
+    # kernel-eligible at all). Pure relabeling — verdicts and fail
+    # segments are unchanged (see LJ.remap_slots).
+    segs, P_eff = LJ.remap_slots(segs)
+    P = max(P_eff, 1)
     info: dict = {"backend": "device", "n_states": mm.n_states,
-                  "n_transitions": mm.n_transitions}
+                  "n_transitions": mm.n_transitions,
+                  "effective_slots": P}
     sizes = {"n_states": mm.n_states, "n_transitions": mm.n_transitions}
     # bucket the slot axis to the next even value, not pow2: candidate
     # rows scale with P, so pow2 padding costs up to ~25% extra work

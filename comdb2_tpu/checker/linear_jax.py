@@ -463,6 +463,104 @@ def make_segments(packed, s_pad: Optional[int] = None,
     return SegmentStream(inv_proc, inv_tr, ok_proc, seg_index, depth)
 
 
+def remap_slots(segs: SegmentStream, with_maps: bool = False):
+    """Rename process ids in a segment stream to a minimal pool of
+    reusable SLOTS. A process occupies a slot only while its call is
+    open (invoke .. ok); the assignment is determined by the history
+    alone — identical for every config — so renaming is a pure
+    relabeling: verdicts, fail segments, and frontier sizes are
+    unchanged. The effective slot count becomes the maximum number of
+    CONCURRENT open calls, not the process count, which is what gates
+    the fused kernel's tiers (``pallas_seg.spec_for``): a concurrency-10
+    register history with <=6 calls in flight runs the (8,128)/2-word
+    tier instead of the ~45%-slower (16,128)/3-word one, and histories
+    with hundreds of processes but bounded concurrency become
+    kernel-eligible at all. The reference's ``ArrayProcesses`` packs
+    per-process cells the same dense way but never reuses them
+    (``knossos/linear/config.clj:157-295``); reuse is safe here because
+    an ok'd slot is IDLE in every surviving config before the stream
+    can reassign it.
+
+    Allocation is lowest-free-first within each segment's invoke list,
+    releases happen after the segment's ok — so a slot freed by segment
+    s is reusable from segment s+1 on. :info invokes never complete and
+    hold their slot for the rest of the stream (process retirement —
+    the retired id never invokes again, ``core.clj:178-200``).
+
+    Returns ``(segs', P_eff)``, plus ``proc_of_slot`` (int32[S, P_eff];
+    row s = which ORIGINAL process owns each slot after segment s, -1
+    when free) when ``with_maps`` — the inverse needed to decode a
+    device frontier back into process-indexed configs
+    (:func:`comdb2_tpu.checker.counterexample.reconstruct`).
+    """
+    import heapq
+
+    S, K = segs.inv_proc.shape
+    ip = segs.inv_proc.tolist()
+    okl = segs.ok_proc.tolist()
+    out_ip = [row[:] for row in ip]
+    out_ok = list(okl)
+    slot_of: dict = {}
+    free: list = []
+    n_slots = 0
+    maps = [] if with_maps else None
+    owners: list = []
+    for s in range(S):
+        row = ip[s]
+        orow = out_ip[s]
+        for k in range(K):
+            p = row[k]
+            if p < 0:
+                continue
+            if p in slot_of:
+                raise ValueError(
+                    f"process {p} invokes in segment {s} while an "
+                    "earlier invocation is still open")
+            if free:
+                sl = heapq.heappop(free)
+            else:
+                sl = n_slots
+                n_slots += 1
+                owners.append(-1)
+            slot_of[p] = sl
+            owners[sl] = p
+            orow[k] = sl
+        o = okl[s]
+        if o >= 0:
+            sl = slot_of.pop(o, None)
+            if sl is None:
+                # ok without an open invocation: the process's slot is
+                # IDLE in every config, so the ok filter empties the
+                # frontier (INVALID at this segment). Any free slot is
+                # IDLE everywhere too — map to one to preserve exactly
+                # that instead of rejecting the stream.
+                if free:
+                    out_ok[s] = free[0]
+                else:
+                    out_ok[s] = n_slots
+                    n_slots += 1
+                    owners.append(-1)
+                    heapq.heappush(free, out_ok[s])
+            else:
+                out_ok[s] = sl
+                owners[sl] = -1
+                heapq.heappush(free, sl)
+        if with_maps:
+            maps.append(owners[:])
+    P_eff = n_slots
+    segs2 = SegmentStream(
+        np.asarray(out_ip, np.int32).reshape(S, K),
+        segs.inv_tr, np.asarray(out_ok, np.int32),
+        segs.seg_index, segs.depth)
+    if with_maps:
+        pos = np.full((S, max(P_eff, 1)), -1, np.int32)
+        for s, row in enumerate(maps):
+            if row:
+                pos[s, :len(row)] = row
+        return segs2, P_eff, pos
+    return segs2, P_eff
+
+
 def _make_seg_step(succ, F, P, K, bits, Fs=None):
     """One scan step over a segment. With ``Fs`` set (adaptive
     two-tier, see :func:`check_device_seg2`) the closure first runs at

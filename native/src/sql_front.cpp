@@ -138,6 +138,24 @@ bool skip_to_from(Cur &c) {
     return false;
 }
 
+/* Every statement must consume its whole token stream BEFORE its verb
+ * runs: a partially-parsed WHERE clause silently dropping conjuncts
+ * would demote a guarded CAS to a blind write (the reference parser
+ * rejects at the grammar level, sqlinterfaces.c dispatch). Returns ""
+ * when exhausted, else the ERR reply. */
+std::string want_done(Cur &c, const std::string &what) {
+    if (c.done()) return "";
+    return "ERR " + what + ": unparsed trailing tokens";
+}
+
+/* optional ORDER BY <col> tail on selects (results are ordered by
+ * construction; clients sort) */
+void eat_order_by(Cur &c) {
+    size_t save = c.i;
+    if (c.eat("order") && c.eat("by") && c.next() != nullptr) return;
+    c.i = save;
+}
+
 std::string mutate(Session &s, const VerbRunner &run,
                    const std::string &verb) {
     /* non-txn DML rides the M replay-nonce wrapper when the session
@@ -163,6 +181,9 @@ std::string sel_register(Session &s, const VerbRunner &run, Cur &c) {
         if (!eat_eq(c, &col, &key) || col != "id")
             return "ERR select register: expected WHERE id = <int>";
     }
+    eat_order_by(c);
+    std::string err = want_done(c, "select register");
+    if (!err.empty()) return err;
     if (s.txid >= 0)
         return run("TR " + std::to_string(s.txid) + " " +
                    std::to_string(key));
@@ -181,6 +202,9 @@ std::string sel_table(Session &s, const VerbRunner &run, Cur &c,
     long long key = 0;
     if (!eat_eq(c, &col, &key) || (col != "k" && col != "key"))
         return "ERR select " + tbl + ": expected WHERE k = <int>";
+    eat_order_by(c);
+    std::string err = want_done(c, "select " + tbl);
+    if (!err.empty()) return err;
     return run("TP " + std::to_string(s.txid) + " " + tbl + " " +
                std::to_string(key));
 }
@@ -190,10 +214,15 @@ std::string do_select(Session &s, const VerbRunner &run, Cur &c) {
     const std::string *tbl = c.next();
     if (tbl == nullptr) return "ERR select: missing table";
     if (*tbl == "register") return sel_register(s, run, c);
-    if (*tbl == "jepsen") return run("S");     /* ORDER BY implicit:
+    if (*tbl == "jepsen") {                    /* ORDER BY implicit:
                                                 * the S verb returns
                                                 * insertion order;
                                                 * clients sort */
+        eat_order_by(c);
+        std::string err = want_done(c, "select jepsen");
+        if (!err.empty()) return err;
+        return run("S");
+    }
     if (*tbl == "a" || *tbl == "b") return sel_table(s, run, c, *tbl);
     return "ERR unknown table " + *tbl;
 }
@@ -209,6 +238,8 @@ std::string do_insert(Session &s, const VerbRunner &run, Cur &c) {
     if (!eat_tuple(c, &vals)) return "ERR insert: bad VALUES tuple";
     if (!cols.empty() && cols.size() != vals.size())
         return "ERR insert: column/value count mismatch";
+    std::string err = want_done(c, "insert");
+    if (!err.empty()) return err;
 
     if (*tbl == "register") {
         /* (id, val) — or positional */
@@ -281,7 +312,12 @@ std::string do_update(Session &s, const VerbRunner &run, Cur &c) {
     if (c.eat("where")) {
         std::string wcol;
         long long wval = 0;
-        while (eat_eq(c, &wcol, &wval)) {
+        /* every conjunct must parse and only AND may connect them —
+         * a clause this grammar can't express must ERR, never demote
+         * a guarded CAS into an unconditional write */
+        for (;;) {
+            if (!eat_eq(c, &wcol, &wval))
+                return "ERR update: bad WHERE clause";
             if (wcol == "id") key = wval;
             else if (wcol == "val" || wcol == "value") {
                 expect = wval;
@@ -292,6 +328,8 @@ std::string do_update(Session &s, const VerbRunner &run, Cur &c) {
             if (!c.eat("and")) break;
         }
     }
+    std::string err = want_done(c, "update");
+    if (!err.empty()) return err;
     if (s.txid < 0) {
         if (has_expect)      /* the CAS shape, comdb2/core.clj:432-474 */
             return mutate(s, run, "C " + std::to_string(key) + " " +
@@ -317,21 +355,31 @@ std::string do_update(Session &s, const VerbRunner &run, Cur &c) {
 }
 
 std::string do_set(Session &s, Cur &c) {
+    std::string err;
     if (c.eat("hasql")) {
-        if (c.eat("on")) { s.hasql = true; return "OK"; }
-        if (c.eat("off")) { s.hasql = false; return "OK"; }
-        return "ERR set hasql: expected on|off";
+        bool on;
+        if (c.eat("on")) on = true;
+        else if (c.eat("off")) on = false;
+        else return "ERR set hasql: expected on|off";
+        if (!(err = want_done(c, "set hasql")).empty()) return err;
+        s.hasql = on;
+        return "OK";
     }
     if (c.eat("transaction")) {
         /* level recorded; the wire txn surface is serializable by
-         * construction (OCC validation at commit) */
-        s.serializable = c.at("serializable");
+         * construction (OCC validation at commit). The level may be
+         * multi-word ("read committed") — consume it all. */
+        bool ser = false;
+        while (const std::string *w = c.next())
+            if (*w == "serializable") ser = true;
+        s.serializable = ser;
         return "OK";
     }
     if (c.eat("max_retries")) {
         const std::string *n = c.next();
         if (n == nullptr || !is_num(*n))
             return "ERR set max_retries: expected <int>";
+        if (!(err = want_done(c, "set max_retries")).empty()) return err;
         s.max_retries = num(*n);
         return "OK";
     }
@@ -339,6 +387,7 @@ std::string do_set(Session &s, Cur &c) {
         const std::string *n = c.next();
         if (n == nullptr || !is_num(*n))
             return "ERR set cnonce: expected <int>";
+        if (!(err = want_done(c, "set cnonce")).empty()) return err;
         s.cnonce = (unsigned long long)num(*n);
         return "OK";
     }
@@ -363,7 +412,9 @@ std::string execute(const std::string &sql, Session &s,
     std::vector<std::string> toks = tokenize(sql);
     Cur c{toks};
     if (c.eat("set")) return do_set(s, c);
+    std::string err;
     if (c.eat("begin")) {
+        if (!(err = want_done(c, "begin")).empty()) return err;
         if (s.txid >= 0) return "ERR transaction already open";
         std::string r = run("TB");
         if (r.rfind("T ", 0) != 0) return r;
@@ -371,6 +422,7 @@ std::string execute(const std::string &sql, Session &s,
         return "OK";
     }
     if (c.eat("commit")) {
+        if (!(err = want_done(c, "commit")).empty()) return err;
         if (s.txid < 0) return "ERR no open transaction";
         std::string line = "TC " + std::to_string(s.txid);
         if (s.cnonce != 0) {
@@ -381,6 +433,7 @@ std::string execute(const std::string &sql, Session &s,
         return run(line);
     }
     if (c.eat("rollback")) {
+        if (!(err = want_done(c, "rollback")).empty()) return err;
         if (s.txid < 0) return "ERR no open transaction";
         std::string r = run("TA " + std::to_string(s.txid));
         s.txid = -1;

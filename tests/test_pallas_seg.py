@@ -312,14 +312,14 @@ def test_interpret_rows_tier_matches_full_width(interpret_kernel):
             h = h + [O.invoke(90, "read", None), O.ok(90, "read", 9)]
         hs.append(h)
     batch = pack_batch(hs, M.cas_register())
-    segs_list = _stream_segments(batch)
+    segs_list, P_stream = _stream_segments(batch)
     sizes = dict(n_states=batch.memo.n_states,
                  n_transitions=batch.memo.n_transitions)
     ref = PS.check_device_pallas_stream(
-        batch.memo.succ, segs_list, P=batch.P, row_parallel=False,
+        batch.memo.succ, segs_list, P=P_stream, row_parallel=False,
         **sizes)
     got = PS.check_device_pallas_stream(
-        batch.memo.succ, segs_list, P=batch.P, row_parallel=True,
+        batch.memo.succ, segs_list, P=P_stream, row_parallel=True,
         **sizes)
     assert ref is not None and got is not None
     for a, g in zip(ref, got):

@@ -1,0 +1,229 @@
+"""Slot renaming (``linear_jax.remap_slots``) — the round-5 transform
+that maps process ids onto a minimal pool of reusable slots so every
+engine's slot axis scales with max CONCURRENT open calls instead of
+process count (the fused kernel's tier gate, round-4 Weak #4).
+
+Renaming is a pure relabeling of a segment stream: verdicts, fail
+segments, and frontier sizes must be bit-identical through any engine.
+The reference's ``ArrayProcesses`` packs per-process cells densely but
+never reuses them (``knossos/linear/config.clj:157-295``).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import comdb2_tpu.checker.linear_jax as LJ
+import comdb2_tpu.models.model as M
+from comdb2_tpu.checker import linear_host
+from comdb2_tpu.checker.linear import analysis
+from comdb2_tpu.models.memo import memo as make_memo
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.packed import pack_history
+
+import histgen
+
+
+def _segs(h, **kw):
+    return LJ.make_segments(pack_history(h), **kw)
+
+
+def test_peff_tracks_concurrency_not_process_count():
+    """10 processes, <=3 calls in flight -> 3 slots."""
+    rng = random.Random(7)
+    h = histgen.register_history(rng, n_procs=10, n_events=400,
+                                 p_info=0.0, max_pending=3)
+    segs = _segs(h)
+    segs2, p_eff = LJ.remap_slots(segs)
+    assert p_eff <= 3
+    assert segs2.inv_proc.max() < p_eff
+    assert segs2.ok_proc.max() < p_eff
+    # untouched fields ride through
+    assert segs2.seg_index is segs.seg_index
+    assert segs2.depth is segs.depth
+    assert segs2.inv_tr is segs.inv_tr
+
+
+def test_remap_is_idempotent():
+    rng = random.Random(11)
+    h = histgen.register_history(rng, n_procs=8, n_events=300,
+                                 p_info=0.1, max_pending=4)
+    s1, p1 = LJ.remap_slots(_segs(h))
+    s2, p2 = LJ.remap_slots(s1)
+    assert p1 == p2
+    np.testing.assert_array_equal(s1.inv_proc, s2.inv_proc)
+    np.testing.assert_array_equal(s1.ok_proc, s2.ok_proc)
+
+
+def test_info_invokes_pin_their_slot():
+    """:info ops never complete: their slot must stay allocated (the
+    process retired — reusing the slot would let a later invoke
+    corrupt the still-maybe-pending op)."""
+    h = [O.invoke(0, "w", 1), O.info(0, "w", 1),      # p0 crashes
+         O.invoke(1, "w", 2), O.ok(1, "w", 2),
+         O.invoke(2, "w", 3), O.ok(2, "w", 3)]
+    segs2, p_eff = LJ.remap_slots(_segs(h))
+    # p0 holds slot 0 forever; p1 gets slot 1, frees it; p2 reuses 1
+    assert p_eff == 2
+    ok = segs2.ok_proc[segs2.ok_proc >= 0]
+    assert list(ok) == [1, 1]
+
+
+def test_ok_without_open_invocation_stays_invalid():
+    """A defensive path: an ok with no open call previously filtered
+    on an IDLE process slot (frontier empties -> INVALID); the renamed
+    stream must preserve that by mapping it to a free slot."""
+    segs = LJ.SegmentStream(
+        inv_proc=np.full((2, 1), -1, np.int32),
+        inv_tr=np.zeros((2, 1), np.int32),
+        ok_proc=np.array([0, -1], np.int32),     # ok, no invoke
+        seg_index=np.zeros(2, np.int64),
+        depth=np.zeros(2, np.int32))
+    segs2, p_eff = LJ.remap_slots(segs)
+    assert p_eff == 1
+    mm = make_memo(M.register(), pack_history(
+        [O.invoke(0, "w", 1), O.ok(0, "w", 1)]))
+    status, fail, _ = LJ.check_device_seg2(
+        LJ.pad_succ(mm.succ, 8, 8), segs2.inv_proc, segs2.inv_tr,
+        segs2.ok_proc, segs2.depth, F=8, Fs=4, P=2,
+        n_states=mm.n_states, n_transitions=mm.n_transitions)
+    assert int(status) == LJ.INVALID
+    assert int(fail) == 0
+
+
+def test_double_pending_invoke_rejected():
+    segs = LJ.SegmentStream(
+        inv_proc=np.array([[0], [0]], np.int32),
+        inv_tr=np.zeros((2, 1), np.int32),
+        ok_proc=np.full(2, -1, np.int32),
+        seg_index=np.zeros(2, np.int64),
+        depth=np.zeros(2, np.int32))
+    with pytest.raises(ValueError, match="still open"):
+        LJ.remap_slots(segs)
+
+
+def test_owner_maps_track_allocation():
+    rng = random.Random(3)
+    h = histgen.register_history(rng, n_procs=6, n_events=200,
+                                 p_info=0.1, max_pending=3)
+    segs = _segs(h)
+    segs2, p_eff, owners = LJ.remap_slots(segs, with_maps=True)
+    S, K = segs.inv_proc.shape
+    alloc = {}
+    for s in range(S):
+        for k in range(K):
+            p, sl = segs.inv_proc[s, k], segs2.inv_proc[s, k]
+            if p >= 0:
+                alloc[int(sl)] = int(p)
+        if segs.ok_proc[s] >= 0:
+            del alloc[int(segs2.ok_proc[s])]
+        for q in range(p_eff):
+            assert owners[s, q] == alloc.get(q, -1), (s, q)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_verdict_parity_xla_engine(seed):
+    """Renamed stream through the XLA seg engine == original stream ==
+    host engine, across valid/invalid/info-heavy histories."""
+    rng = random.Random(900 + seed)
+    h = histgen.register_history(
+        rng, n_procs=rng.choice([4, 8, 12]),
+        n_events=rng.choice([60, 200]),
+        p_info=rng.choice([0.0, 0.15]),
+        max_pending=rng.choice([2, 3, 4]))
+    if rng.random() < 0.5:
+        h = histgen.mutate(rng, h)
+    packed = pack_history(h)
+    mm = make_memo(M.cas_register(), packed)
+    segs = LJ.make_segments(packed, s_pad=128, k_pad=8)
+    if segs.inv_proc.shape != (128, 8):
+        pytest.skip("segment shape over bucket")
+    segs2, p_eff = LJ.remap_slots(segs)
+    # info ops pin slots forever, so the bound is max_pending plus the
+    # number of crashed (info) invocations — not max_pending alone
+    assert p_eff <= len(packed.process_table)
+    succ = LJ.pad_succ(mm.succ, 64, 64)
+    sizes = dict(n_states=mm.n_states, n_transitions=mm.n_transitions)
+    P_orig = max(len(packed.process_table), 2)
+    r1 = LJ.check_device_seg2(succ, segs.inv_proc, segs.inv_tr,
+                              segs.ok_proc, segs.depth, F=64, Fs=8,
+                              P=P_orig + (P_orig & 1), **sizes)
+    r2 = LJ.check_device_seg2(succ, segs2.inv_proc, segs2.inv_tr,
+                              segs2.ok_proc, segs2.depth, F=64, Fs=8,
+                              P=max(p_eff + (p_eff & 1), 2), **sizes)
+    assert [int(x) for x in r1] == [int(x) for x in r2]
+    if int(r1[0]) != LJ.UNKNOWN:
+        hr = linear_host.check(mm, packed, max_configs=1 << 18)
+        assert (int(r1[0]) == LJ.VALID) == hr.valid
+
+
+def test_analysis_wide_p_low_concurrency_invalid_counterexample():
+    """End to end: 12 processes / concurrency 3, corrupted history.
+    The driver renames slots (info reports the effective count) and
+    the counterexample decodes back to ORIGINAL process ids."""
+    rng = random.Random(21)
+    for attempt in range(20):
+        h = histgen.register_history(rng, n_procs=12, n_events=240,
+                                     p_info=0.0, max_pending=3)
+        h = histgen.mutate(rng, h)
+        a = analysis(M.cas_register(), h, backend="device")
+        if a.valid is False:
+            break
+    else:
+        pytest.fail("no invalid mutation found")
+    assert a.info.get("effective_slots", 99) <= 3
+    # counterexample configs name real processes from the history
+    procs = {op.process for op in h}
+    for cfg in a.configs:
+        assert set(cfg.get("pending", {})) <= procs
+    for path in a.info.get("paths", []):
+        for step in path:
+            opd = step["op"]
+            if isinstance(opd, dict):
+                assert opd["process"] in procs
+
+
+def test_segment_batch_accepts_prebuilt_renamed_streams():
+    """The keys/flat fallback reuses the stream path's already-built
+    (union-remapped, slot-renamed) streams instead of re-running the
+    O(total-ops) segment pass — verdicts must match the from-scratch
+    SegmentBatch through the keys engine."""
+    from comdb2_tpu.checker.batch import (_stream_segments, pack_batch,
+                                          segment_batch)
+
+    rng = random.Random(17)
+    hs = []
+    for i in range(12):
+        h = histgen.register_history(rng, n_procs=rng.randint(2, 6),
+                                     n_events=rng.randint(20, 60),
+                                     p_info=0.0)
+        if i % 3 == 0:
+            h = histgen.mutate(rng, h)
+        hs.append(h)
+    batch = pack_batch(hs, M.cas_register())
+    streams, _ = _stream_segments(batch)
+    succ = LJ.pad_succ(batch.memo.succ, 64, 64)
+    sizes = dict(n_states=batch.memo.n_states,
+                 n_transitions=batch.memo.n_transitions)
+    P = max(batch.P + (batch.P & 1), 2)
+    outs = []
+    for sb in (segment_batch(batch), segment_batch(batch,
+                                                   streams=streams)):
+        st, fs, n = LJ.check_device_keys(
+            succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+            B=len(batch), F=64, P=P, **sizes)
+        fail_at = [int(sb.seg_index[b, int(fs[b])]) if int(fs[b]) >= 0
+                   else -1 for b in range(len(batch))]
+        outs.append((np.asarray(st).tolist(), fail_at,
+                     np.asarray(n).tolist()))
+    assert outs[0] == outs[1]
+
+
+def test_analysis_valid_wide_p():
+    rng = random.Random(5)
+    h = histgen.register_history(rng, n_procs=16, n_events=300,
+                                 p_info=0.0, max_pending=4)
+    a = analysis(M.cas_register(), h, backend="device")
+    assert a.valid is True
+    assert a.info.get("effective_slots", 99) <= 5

@@ -133,6 +133,22 @@ def test_sql_statement_grammar(tmp_path):
         # garbage is rejected, not misparsed
         assert c.request("select val from nowhere").startswith("ERR")
         assert c.request("delete from register").startswith("ERR")
+        # a WHERE clause the grammar can't express must ERR without
+        # executing — an OR-connected guard must never demote the CAS
+        # to a blind write (round-5 code review)
+        assert c.request("select val from register "
+                         "where id = 1") == "V 3"
+        assert c.request("update register set val = 9 "
+                         "where id = 1 or val = 3").startswith("ERR")
+        assert c.request("update register set val = 9 "
+                         "where id = 1 and garbage").startswith("ERR")
+        assert c.request("select val from register "
+                         "where id = 1 or id = 2").startswith("ERR")
+        assert c.request("select val from register "
+                         "where id = 1") == "V 3"     # value untouched
+        # known statements with parsed tails still work
+        assert c.request("select value from jepsen "
+                         "order by value") == "V 42 43 77"
         c.close()
     finally:
         _kill(procs)
