@@ -139,33 +139,54 @@ def _bench_batch() -> None:
     }))
 
 
+def _gen_packed_4096(seed: int, events: int):
+    """One distinct packed register history for the 4096x bench
+    (per-history seeds keep the batch deterministic AND distinct)."""
+    import random as _r
+
+    from comdb2_tpu.ops.packed import pack_history
+    from comdb2_tpu.ops.synth import register_history
+
+    return pack_history(register_history(
+        _r.Random(seed), n_procs=N_PROCS, n_events=events, values=5,
+        p_info=0.0))
+
+
 def _bench_batch_4096() -> None:
     """BASELINE.json config 5 — the batch north-star shape: 4096
-    independent register histories x 2k ops checked as one sharded
+    INDEPENDENT register histories x 2k ops checked as one sharded
     launch (single chip here; the 8-device placement is validated by
-    ``dryrun_multichip``). 256 distinct histories are tiled x16 so the
-    one-time host-side generation doesn't dominate the bench; the
-    device checks all 4096 fully and independently either way (the
-    memo/table is shared across the batch by construction)."""
+    ``dryrun_multichip``). Every history is distinct (round-4 Weak #3:
+    tiling 256 x16 warmed caches with duplicate data). The one-time
+    host cost (generation + union packing + the cached segment pass)
+    is reported as ``host_pack_s``; each timed run (``device_run_s``)
+    covers stream chunk packing, tunnel transfer, and device
+    execution — all 4096 histories share one compiled program by
+    construction (the stream is chunk-shaped, history-count
+    independent)."""
     from comdb2_tpu.utils.platform import enable_compile_cache
     enable_compile_cache()
 
     from comdb2_tpu.checker import linear_jax as LJ
-    from comdb2_tpu.checker.batch import check_batch, pack_batch
+    from comdb2_tpu.checker.batch import (_stream_segments, check_batch,
+                                          pack_batch)
     from comdb2_tpu.models.model import cas_register
     from comdb2_tpu.ops.packed import pack_history
     from comdb2_tpu.ops.synth import register_history
 
-    B, DISTINCT, EVENTS = 4096, 256, 4000     # 2k ops per history
-    rng = random.Random(11)
-    packeds = [pack_history(register_history(
-        rng, n_procs=N_PROCS, n_events=EVENTS, values=5, p_info=0.0))
-        for _ in range(DISTINCT)]
-    hs = [packeds[i % DISTINCT] for i in range(B)]
+    B, EVENTS = 4096, 4000                    # 2k ops per history
+    t_host = time.perf_counter()
+    # sequential on purpose: this container exposes ONE CPU
+    # (mp.cpu_count() == 1 — a spawn pool measured 322 s -> 566 s,
+    # pure IPC overhead); the cost is one-time and reported as
+    # host_pack_s, separate from the device seconds
+    packeds = [_gen_packed_4096(11_000_000 + i, EVENTS)
+               for i in range(B)]
     from comdb2_tpu.ops.op import INVOKE
-    n_ops = (B // DISTINCT) * sum(
-        int((p.type == INVOKE).sum()) for p in packeds)
-    batch = pack_batch(hs, cas_register(), build_streams=False)
+    n_ops = sum(int((p.type == INVOKE).sum()) for p in packeds)
+    batch = pack_batch(packeds, cas_register(), build_streams=False)
+    _stream_segments(batch)       # segment pass, cached on the batch
+    host_pack_s = time.perf_counter() - t_host
 
     info: dict = {}
     status, _, _ = check_batch(batch, F=128, info=info)   # compile
@@ -184,8 +205,10 @@ def _bench_batch_4096() -> None:
         "vs_baseline": round(ops_s / BASELINE_OPS_S, 2),
         "engine": info.get("engine"),
         "histories": B,
-        "distinct_histories": DISTINCT,
+        "distinct_histories": B,
         "ops": n_ops,
+        "host_pack_s": round(host_pack_s, 1),
+        "device_run_s": [round(d, 1) for d in dts],
         **_spread(n_ops, dts),
     }))
 

