@@ -198,7 +198,7 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
     # are tiny (p50 ~ 8 configs on the register bench), so each segment
     # first runs at Fs and escalates to F per-segment on overflow (the
     # engine degrades to big-only when F is too small for the tier)
-    info.pop("engine", None)
+    info["engine"] = "xla-seg2"
     Fs = 32
     # Large histories ALWAYS run chunked, progress callback or not:
     # XLA compile time scales with the scan length, and a monolithic

@@ -109,3 +109,29 @@ def mutate(rng: random.Random, history: List[O.Op],
         v = op.value if isinstance(op.value, int) else 0
         h[i] = op.with_(value=(v + 1) % values)
     return h
+
+
+def pinned_wide_history(n_pinned: int = 18,
+                        with_reads: bool = True) -> List[O.Op]:
+    """A history whose EFFECTIVE slot count (max concurrent open
+    calls, post slot-renaming) is ``n_pinned``+1 while the search
+    frontier stays tiny: each pinned slot is a crashed (:info) cas
+    whose expected value (9) is unreachable — forever open, so it
+    holds its slot, but it can never linearize, so it forks no
+    configs. The recipe that still drives the multi-word PackPlan
+    dedup now that slot renaming collapses wide-but-shallow
+    histories (a real concurrency-18 closure is a 2^18 frontier no
+    engine — the reference included — can search). Used by both the
+    ``dryrun_multichip`` wide-P gate stage and the CPU suite so they
+    validate the same history shape."""
+    h: List[O.Op] = []
+    for i in range(n_pinned):
+        h.append(O.invoke(2000 + i, "cas", (9, 1)))   # 9 unreachable
+        h.append(O.info(2000 + i, "cas", (9, 1)))
+        p = i % 3
+        h.append(O.invoke(p, "write", i % 4))
+        h.append(O.ok(p, "write", i % 4))
+        if with_reads:
+            h.append(O.invoke(p, "read", None))
+            h.append(O.ok(p, "read", i % 4))
+    return h

@@ -51,13 +51,13 @@ def test_register_tester_nemesis_end_to_end(tmp_path):
                    base_dir=str(tmp_path / "sut"), timeout_ms=300,
                    elect_ms=500, lease_ms=300)
     ctl = ClusterControl(ports)
-    # the linearizable check runs the HOST engine here: the history's
-    # process width varies run to run (partition-window retirements),
-    # so the device path would compile a fresh program every run
-    # (CLAUDE.md: per-seed shapes recompile). Device-engine
-    # correctness has its own coverage (wide-P host cross-checks,
-    # interpret parity, the TPU fuzz); this test is the full
-    # provision→cluster→nemesis→verdict loop.
+    # the linearizable verdict comes from the DEVICE engine (round-4
+    # Weak #5: this loop had only ever ended in a host verdict). The
+    # per-run shape variance that used to force host — process width
+    # moves with partition-window retirements — is gone: slot renaming
+    # (LJ.remap_slots) caps the slot axis at max concurrent open
+    # calls, and the driver's pow2/even shape buckets bound the
+    # remaining compile variety (persistent-cached across runs).
     from comdb2_tpu.checker import checkers as C
     from comdb2_tpu.checker import independent as I
     from comdb2_tpu.report import Timeline, perf_checker
@@ -65,8 +65,7 @@ def test_register_tester_nemesis_end_to_end(tmp_path):
     checker = C.compose({
         "perf": perf_checker(),
         "timeline": Timeline(),
-        "linearizable": I.checker(
-            C.Linearizable(host_threshold=1 << 20)),
+        "linearizable": I.checker(C.Linearizable()),
     })
     # the reference cycle is 10 s on / 10 s off over 300 s; compress to
     # two ~1.2 s partition windows in a ~6 s run so CI stays fast while
@@ -101,6 +100,11 @@ def test_register_tester_nemesis_end_to_end(tmp_path):
     res = result["results"]
     assert res["valid?"] is True, res
     assert res["linearizable"]["valid?"] is True, res["linearizable"]
+    # the flagship verdict really ended on the device engine
+    (key_res,) = res["linearizable"]["results"].values()
+    assert key_res.get("backend") == "device", key_res
+    assert key_res.get("engine") in ("xla-seg2", "pallas-fused"), key_res
+    assert key_res.get("effective_slots", 99) <= 16, key_res
     history = result["history"]
     oks = [op for op in history
            if op.type == "ok" and op.process != "nemesis"]
@@ -116,3 +120,67 @@ def test_register_tester_nemesis_end_to_end(tmp_path):
     # perf/timeline artifacts rendered alongside the verdict
     assert res["perf"]["valid?"] is True
     assert res["timeline"]["valid?"] is True
+
+    # the PRODUCTION kernel agrees: re-check the flagship history
+    # through the fused Pallas kernel in interpret mode at a FIXED
+    # padded spec — segments to a pow2 bucket, K to 8, slots to 14,
+    # the successor table to (8, 48) — so the compiled program is
+    # byte-identical across runs regardless of history variance (the
+    # interpret compile is paid once ever, then rides the persistent
+    # cache). Fault-window closures can legitimately exceed the
+    # kernel's fixed F=128 (the production driver escalates those to
+    # the XLA ladder — the primary verdict above), so the parity
+    # contract is the fuzz one: kernel vs the XLA engine AT THE SAME
+    # CAPACITY, bit-identical status + fail segment (+ count when
+    # VALID). Skipped only when a fault window packed more than 8
+    # invokes into one segment (the kernel's K bound).
+    import numpy as np
+
+    from comdb2_tpu.checker import independent as I2
+    from comdb2_tpu.checker import linear_jax as LJ
+    from comdb2_tpu.checker import pallas_seg as PSEG
+    from comdb2_tpu.models import model as M
+    from comdb2_tpu.models.memo import memo as make_memo
+    from comdb2_tpu.ops.packed import pack_history
+
+    sub = I2.subhistory(1, history)     # client values are KVTuples
+    packed = pack_history([op for op in sub if op.process != "nemesis"])
+    mm = make_memo(M.cas_register(), packed)
+    segs = LJ.make_segments(packed)
+    from comdb2_tpu.utils import next_pow2
+    K_real = segs.inv_proc.shape[1]
+    S_real = segs.ok_proc.shape[0]
+    runnable = K_real <= 8 and S_real <= 2048
+    print(f"[flagship] kernel cross-check: K={K_real} S={S_real} "
+          f"{'RUN' if runnable else 'SKIP (over kernel bounds)'}")
+    if runnable:
+        segs = LJ.make_segments(packed,
+                                s_pad=next_pow2(S_real, 512), k_pad=8)
+        segs, P_eff2 = LJ.remap_slots(segs)
+        assert P_eff2 <= 14, P_eff2
+        assert mm.n_states <= 8 and mm.n_transitions <= 48, (
+            mm.n_states, mm.n_transitions)
+        succ_pad = np.full((8, 48), -1, np.int32)
+        succ_pad[:mm.n_states, :mm.n_transitions] = mm.succ
+        PSEG.use_interpret(True)
+        try:
+            r = PSEG.check_device_pallas(succ_pad, segs, n_states=8,
+                                         n_transitions=48, P=14)
+        finally:
+            PSEG.use_interpret(False)
+        assert r is not None, "fixed spec must be kernel-eligible"
+        x = LJ.check_device_seg2(
+            LJ.pad_succ(succ_pad, 8, 64), segs.inv_proc, segs.inv_tr,
+            segs.ok_proc, segs.depth, F=128, Fs=32, P=14,
+            n_states=8, n_transitions=48)
+        x = tuple(int(v) for v in x)
+        print(f"[flagship] kernel={r} xla@128={x}")
+        assert r[0] == x[0], (r, x)
+        assert r[1] == x[1], (r, x)            # same fail segment
+        if r[0] == LJ.VALID:
+            assert r[2] == x[2], (r, x)
+        else:
+            # overflow is legitimate under fault windows, but the
+            # HISTORY itself is linearizable — the primary device
+            # verdict at the escalated capacity said so above
+            assert r[0] == LJ.UNKNOWN, r

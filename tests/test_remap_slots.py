@@ -220,6 +220,27 @@ def test_segment_batch_accepts_prebuilt_renamed_streams():
     assert outs[0] == outs[1]
 
 
+def test_pinned_slots_drive_multiword_packplan():
+    """Slot renaming collapses wide-but-shallow histories, so the
+    multi-word PackPlan dedup needs genuinely wide OPEN-call
+    concurrency — crashed cas ops with an unreachable expected value
+    pin slots forever at zero frontier cost. The device engine must
+    agree with host at effective_slots ~19 (4 packed words)."""
+    from comdb2_tpu.ops.synth import pinned_wide_history
+
+    packed = pack_history(pinned_wide_history(18, with_reads=False))
+    mm = make_memo(M.cas_register(), packed)
+    hr = linear_host.check(mm, packed, max_configs=1 << 16)
+    a = analysis(M.cas_register(), packed, backend="device")
+    assert a.valid is True and hr.valid is True
+    assert a.final_count == hr.final_count
+    p_eff = a.info["effective_slots"]
+    assert p_eff >= 18
+    plan = LJ.make_pack_plan(mm.n_states, mm.n_transitions,
+                             p_eff + (p_eff & 1))
+    assert plan is not None and plan.n_words >= 3, plan
+
+
 def test_analysis_valid_wide_p():
     rng = random.Random(5)
     h = histgen.register_history(rng, n_procs=16, n_events=300,
