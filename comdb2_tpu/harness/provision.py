@@ -18,6 +18,7 @@ need nothing pre-installed beyond libc).
 
 from __future__ import annotations
 
+import shlex
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -91,32 +92,38 @@ class SutNodeDB(db_ns.DB, db_ns.Primary, db_ns.LogFiles):
 
     def setup(self, test: dict, node: str) -> None:
         host = self.layout[node].host
-        d = self._dir(node)
-        self.remote.execute(host, f"mkdir -p {d} && rm -rf {d}/state")
+        d = shlex.quote(self._dir(node))
+        self.remote.execute(host,
+                            f"mkdir -p {d} && rm -rf {d}/state")
         if (host, node) not in self._installed:
             self.remote.upload(host, self.binary, self._bin(node))
-            self.remote.execute(host, f"chmod +x {self._bin(node)}")
+            self.remote.execute(
+                host, f"chmod +x {shlex.quote(self._bin(node))}")
             self._installed.add((host, node))
         i = self._node_id(test, node)
         args = [self._bin(node), "-i", str(i), "-n", self._peers(test),
                 "-t", str(self.timeout_ms),
                 "-e", str(self.elect_ms), "-l", str(self.lease_ms)]
         if self.persistent:
-            args += ["-d", f"{d}/state"]
+            args += ["-d", f"{self._dir(node)}/state"]
         args += self.flags
-        cmd = " ".join(args)
+        # quote each argv element: base dirs/node names/flags with
+        # shell metacharacters must not corrupt the command line or
+        # the config heredoc (ADVICE r4)
+        cmd = " ".join(shlex.quote(a) for a in args)
         # the setvars role: the exact configuration is an artifact
         self.remote.execute(
-            host, f"printf '%s\\n' '{cmd}' > {d}/config")
+            host,
+            f"printf '%s\\n' {shlex.quote(cmd)} > {d}/config")
         self.remote.execute(
             host,
-            f"nohup {cmd} > {self._logfile(node)} 2>&1 & "
-            f"echo $! > {self._pidfile(node)}")
+            f"nohup {cmd} > {shlex.quote(self._logfile(node))} 2>&1 & "
+            f"echo $! > {shlex.quote(self._pidfile(node))}")
         self._await_ready(host, self.layout[node].port)
 
     def teardown(self, test: dict, node: str) -> None:
         host = self.layout[node].host
-        pf = self._pidfile(node)
+        pf = shlex.quote(self._pidfile(node))
         self.remote.execute(
             host, f"[ -f {pf} ] && kill -9 $(cat {pf}) 2>/dev/null; "
                   f"rm -f {pf}; true")

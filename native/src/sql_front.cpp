@@ -368,10 +368,18 @@ std::string do_set(Session &s, Cur &c) {
     if (c.eat("transaction")) {
         /* level recorded; the wire txn surface is serializable by
          * construction (OCC validation at commit). The level may be
-         * multi-word ("read committed") — consume it all. */
+         * multi-word ("read committed") — every token must come from
+         * the known isolation vocabulary (a typo'd level must ERR,
+         * not silently run at the wrong isolation). */
         bool ser = false;
-        while (const std::string *w = c.next())
+        while (const std::string *w = c.next()) {
             if (*w == "serializable") ser = true;
+            else if (*w != "read" && *w != "committed" &&
+                     *w != "uncommitted" && *w != "repeatable" &&
+                     *w != "snapshot" && *w != "isolation" &&
+                     *w != "level")
+                return "ERR set transaction: unknown level token " + *w;
+        }
         s.serializable = ser;
         return "OK";
     }

@@ -977,7 +977,14 @@ std::string commit_txn(long long txid, unsigned long long nonce) {
             }
             if (txn.writes.empty()) {
                 /* read-only: its commit point is now; needs the same
-                 * lease + read barrier as a plain read */
+                 * lease + read barrier as a plain read. A conflicted
+                 * read-only txn under -R has nothing to dirty-apply —
+                 * it must keep reporting FAIL like the default path
+                 * (the -R contract alters write-txn REPORTING only;
+                 * returning OK here would commit a torn read snapshot
+                 * as clean — ADVICE r4) */
+                if (lied)
+                    return "FAIL";
                 if (!n.durable ||
                     (n.lease_fresh_locked() &&
                      n.durable_lsn >= n.term_start_lsn))

@@ -292,37 +292,44 @@ def test_interpret_kernel_wide_p10(interpret_kernel):
             assert r[2] == int(n)
 
 
-def test_interpret_rows_tier_matches_full_width(interpret_kernel):
-    """The row-parallel stream tier (8 histories per scan) must agree
-    with the full-width stream engine after its mini-frontier
-    escalation — bit-identical verdicts, fail indices, and (on VALID)
-    counts."""
+def test_interpret_stream_renamed_slots_matches_host(interpret_kernel):
+    """The streamed kernel over slot-RENAMED segments (the production
+    batch path since round 5) must agree with the host engine —
+    verdicts, fail indices, and (on VALID) counts. (Replaces the
+    row-parallel tier parity test: that tier measured strictly slower
+    at every real shape and was removed — round-4 VERDICT Weak #7.)"""
     import random
 
     import histgen
+    from comdb2_tpu.checker import linear_host
     from comdb2_tpu.checker.batch import pack_batch, _stream_segments
+    from comdb2_tpu.ops.packed import pack_history
 
     rng = random.Random(31)
     hs = []
     for i in range(20):
-        h = histgen.register_history(rng, n_procs=rng.randint(2, 4),
+        h = histgen.register_history(rng, n_procs=rng.randint(2, 9),
                                      n_events=rng.randint(8, 40),
-                                     values=3, p_info=0.0)
+                                     values=3, p_info=0.0,
+                                     max_pending=3)
         if i % 4 == 1:
             h = h + [O.invoke(90, "read", None), O.ok(90, "read", 9)]
         hs.append(h)
     batch = pack_batch(hs, M.cas_register())
     segs_list, P_stream = _stream_segments(batch)
+    assert P_stream <= 4          # renaming collapsed 9-proc histories
     sizes = dict(n_states=batch.memo.n_states,
                  n_transitions=batch.memo.n_transitions)
-    ref = PS.check_device_pallas_stream(
-        batch.memo.succ, segs_list, P=P_stream, row_parallel=False,
-        **sizes)
     got = PS.check_device_pallas_stream(
-        batch.memo.succ, segs_list, P=P_stream, row_parallel=True,
-        **sizes)
-    assert ref is not None and got is not None
-    for a, g in zip(ref, got):
-        assert (a[0], a[1]) == (g[0], g[1]), (a, g)
-        if a[0] == LJ.VALID:
-            assert a[2] == g[2], (a, g)
+        batch.memo.succ, segs_list, P=P_stream, **sizes)
+    assert got is not None
+    from comdb2_tpu.models.memo import memo as make_memo
+    for i, (h, g) in enumerate(zip(hs, got)):
+        packed = pack_history(list(h))
+        hr = linear_host.check(make_memo(M.cas_register(), packed),
+                               packed, max_configs=1 << 16)
+        assert (g[0] == LJ.VALID) == hr.valid, (g, hr.valid)
+        if g[0] == LJ.VALID:
+            assert g[2] == hr.final_count, (g, hr)
+        else:
+            assert int(segs_list[i].seg_index[g[1]]) == hr.op_index

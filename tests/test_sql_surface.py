@@ -149,6 +149,12 @@ def test_sql_statement_grammar(tmp_path):
         # known statements with parsed tails still work
         assert c.request("select value from jepsen "
                          "order by value") == "V 42 43 77"
+        # isolation levels come from a known vocabulary: a typo must
+        # ERR, never silently run at the wrong isolation
+        assert c.request("set transaction read committed") == "OK"
+        assert c.request("set transaction serialzable").startswith(
+            "ERR")
+        assert c.request("set transaction serializable") == "OK"
         c.close()
     finally:
         _kill(procs)

@@ -404,6 +404,18 @@ def test_dirty_reads_dirty_commit_control_detected():
         res = dirty_reads_checker.check(None, None, history)
         assert res["valid?"] is False, res
         assert res["dirty-reads"], res
+
+        # -R alters WRITE-txn reporting only: a conflicted READ-ONLY
+        # txn has nothing to dirty-apply and must keep failing cleanly
+        # instead of committing a torn read snapshot as OK (ADVICE r4)
+        w = ClusterTxn(conn)
+        ro = ClusterTxn(conn)
+        ro.begin()
+        ro.read(base)                    # records version
+        w.begin()
+        w.write(base, 9)
+        assert w.commit() == "ok"        # bumps the version under ro
+        assert ro.commit() == "fail"
     finally:
         conn.close()
         _kill(procs)
