@@ -120,8 +120,14 @@ class SSHRemote(Remote):
         return args
 
     def _dest(self, host: str, path: str) -> str:
+        # scp's remote side word-splits the path through the remote
+        # shell — quote it so dirs with spaces/metacharacters survive
+        # (the provisioner quotes its execute() lines the same way)
+        import shlex
+
         user = self.opts.get("username")
-        return (f"{user}@{host}:{path}" if user else f"{host}:{path}")
+        q = shlex.quote(path)
+        return (f"{user}@{host}:{q}" if user else f"{host}:{q}")
 
     def upload(self, host, local, remote_path):
         subprocess.run(self._scp_base() + [local,

@@ -3,15 +3,24 @@
 The role of ``knossos/linear/report.clj`` (``render-analysis!``,
 ``report.clj:629``): a process/time grid of the operations surrounding
 the point where the frontier died, the crashing op highlighted, and the
-surviving frontier's model states at death listed alongside. Rendered on
-a rank-based (time-warped) x axis like the reference, so dense regions
-stay readable.
+surviving frontier's model states at death listed alongside.
 
-Failed linearization orders are drawn SPATIALLY (``report.clj:385-647``):
-each path is an arrow chain over the time grid, hopping from op bar to
-op bar in linearization order with the resulting model state labeled on
-each hop and the inconsistent step in red — plus a per-path mini
-timeline beneath for paths whose ops fall outside the window."""
+The x axis uses the ops' REAL timestamps warped by density
+(``warp-time-coordinates``, ``report.clj:385-410``): per unit region
+the scale is that region's bar density over the maximum density, and
+offsets accumulate — dead stretches of the timeline compress while the
+contended region around the failure keeps full resolution. Histories
+without timestamps fall back to rank coordinates (uniform density —
+the same map with every region at scale 1).
+
+ALL final paths are drawn SPATIALLY (``report.clj:385-647``): each
+path is an arrow chain over the time grid, hopping from op bar to op
+bar in linearization order with the resulting model state labeled on
+each hop and the inconsistent step in red. Segments shared by several
+paths are drawn ONCE (the ``merge-lines`` role, ``report.clj:300-351``
+— final paths of one frontier share long prefixes, and overdrawing
+them N times makes the plot unreadable). Paths whose ops fall outside
+the window get per-path mini timelines beneath."""
 
 from __future__ import annotations
 
@@ -23,9 +32,53 @@ from .svg import SVG
 
 BAR = {"ok": "#B7FFB7", "fail": "#FFD4D5", "info": "#FEFFC1",
        None: "#C1DEFF"}
-PATH_COLORS = ["#7A4DD8", "#0B7285", "#B8860B", "#C2255C"]
+PATH_COLORS = ["#7A4DD8", "#0B7285", "#B8860B", "#C2255C",
+               "#2F9E44", "#E8590C", "#1971C2", "#862E9C"]
 ROW_H = 22
 WINDOW = 40  # ops of context on each side of the failure
+
+
+def warp_time_coordinates(span_times, tmin: float, tmax: float,
+                          n_buckets: int = 96):
+    """Density-warped time map (``report.clj:385-410``): returns
+    ``f(t) -> [0, 1]`` monotone over ``[tmin, tmax]``. The axis is cut
+    into unit regions; each region's scale is its bar-endpoint density
+    over the max density, and offsets accumulate — so empty stretches
+    collapse to slivers while the densest region keeps full width.
+
+    ``span_times``: iterable of (process, t0, t1) bar extents (the
+    per-process max count per region is the density, like the
+    reference's ``coordinate-density``)."""
+    if tmax <= tmin:
+        return lambda t: 0.0
+    unit = (tmax - tmin) / n_buckets
+    counts: dict = {}
+    for (p, t0, t1) in span_times:
+        for t in (t0, t1):
+            b = min(int((t - tmin) / unit), n_buckets - 1)
+            key = (b, p)
+            counts[key] = counts.get(key, 0) + 1
+    density = [0] * n_buckets
+    for (b, _p), c in counts.items():
+        density[b] = max(density[b], c)
+    dmax = max(max(density), 1)
+    # empty regions keep a QUARTER-bar floor (the reference floors at
+    # one bar, report.clj:399 — which barely compresses sparse
+    # histories where dmax is 1-2; a smaller floor keeps the map
+    # monotone and readable while actually collapsing dead time)
+    scales = [max(d, 0.25) / dmax for d in density]
+    offsets = [0.0] * (n_buckets + 1)
+    for b in range(n_buckets):
+        offsets[b + 1] = offsets[b] + scales[b]
+    total = offsets[n_buckets] or 1.0
+
+    def f(t: float) -> float:
+        x = (t - tmin) / unit
+        b = min(max(int(x), 0), n_buckets - 1)
+        frac = min(max(x - b, 0.0), 1.0)
+        return (offsets[b] + scales[b] * frac) / total
+
+    return f
 
 
 def render_analysis(history: Sequence[Op], analysis,
@@ -41,8 +94,13 @@ def render_analysis(history: Sequence[Op], analysis,
     # pair invocations with completions inside the window; keep BOTH
     # the invoked and the completed value — final paths describe ops
     # by their back-filled (completed) values, the bar label by the
-    # invoked one
-    spans = []  # (process, f, inv_value, comp_value, r0, r1, type)
+    # invoked one. Coordinates are REAL op times (density-warped
+    # below); rank is the fallback when the history carries none.
+    times = [getattr(op, "time", None) for op in window]
+    use_time = all(t is not None for t in times) and len(window) > 1 \
+        and max(times) > min(times)
+    coord = (lambda r: float(times[r])) if use_time else float
+    spans = []  # (process, f, inv_value, comp_value, t0, t1, type)
     inflight = {}
     for rank, op in enumerate(window):
         if op.type == "invoke":
@@ -50,21 +108,29 @@ def render_analysis(history: Sequence[Op], analysis,
         elif op.process in inflight:
             r0, inv = inflight.pop(op.process)
             spans.append((op.process, inv.f, inv.value, op.value,
-                          r0, rank, op.type))
+                          coord(r0), coord(rank), op.type))
+    end_t = coord(len(window) - 1) if window else 0.0
     for p, (r0, inv) in inflight.items():
-        spans.append((p, inv.f, inv.value, inv.value, r0, len(window),
-                      None))
+        spans.append((p, inv.f, inv.value, inv.value, coord(r0),
+                      end_t, None))
 
     procs = sorted({s[0] for s in spans}, key=repr)
     prow = {p: i for i, p in enumerate(procs)}
-    n = max(len(window), 1)
 
     width, left = 980, 90
-    lane = (width - left - 240) / n
-    paths = list(_paths_of(analysis))[:4]
+    plot_w = width - left - 240
+    tmin = min((s[4] for s in spans), default=0.0)
+    tmax = max((s[5] for s in spans), default=1.0)
+    warp = warp_time_coordinates(
+        [(s[0], s[4], s[5]) for s in spans], tmin, tmax)
+
+    def X(t: float) -> float:
+        return left + warp(t) * plot_w
+
+    paths = list(_paths_of(analysis))
     # anchor paths to grid bars up front: anchorable paths draw over
     # the grid, the rest get mini timelines (and size the canvas)
-    anchors = _span_anchors(spans, prow, left, lane)
+    anchors = _span_anchors(spans, prow, X)
     anchored, rest = [], []
     for p in paths:
         op_steps = [s for s in p
@@ -88,12 +154,14 @@ def render_analysis(history: Sequence[Op], analysis,
         svg.line(left, y + ROW_H / 2, width - 240, y + ROW_H / 2,
                  stroke="#eee")
 
-    fail_rank = (fail_at - lo) if fail_at is not None else None
-    for (p, f, value, _cv, r0, r1, typ) in spans:
+    fail_t = (coord(fail_at - lo)
+              if fail_at is not None and 0 <= fail_at - lo < len(window)
+              else None)
+    for (p, f, value, _cv, t0, t1, typ) in spans:
         y = 40 + prow[p] * ROW_H + 2
-        x0 = left + r0 * lane
-        w = max((r1 - r0) * lane, 3)
-        crashing = fail_rank is not None and r0 <= fail_rank <= r1 \
+        x0 = X(t0)
+        w = max(X(t1) - x0, 3)
+        crashing = fail_t is not None and t0 <= fail_t <= t1 \
             and typ == "ok"
         svg.rect(x0, y, w, ROW_H - 6,
                  fill=BAR.get(typ, "#C1DEFF"),
@@ -103,8 +171,8 @@ def render_analysis(history: Sequence[Op], analysis,
         svg.text(x0 + 2, y + ROW_H - 10, label[: max(int(w / 6), 4)],
                  size=9)
 
-    if fail_rank is not None:
-        x = left + (fail_rank + 0.5) * lane
+    if fail_t is not None:
+        x = X(fail_t)
         svg.line(x, 32, x, 40 + ROW_H * len(procs), stroke="#c0392b",
                  width=1.5, dash="4,3")
         svg.text(x, 30, "frontier died here", size=9, fill="#c0392b",
@@ -114,9 +182,15 @@ def render_analysis(history: Sequence[Op], analysis,
     # (knossos/linear/report.clj:385-647): each path hops across the
     # op bars of the grid in linearization order; every hop is labeled
     # with the model state it produced and the inconsistent step is
-    # red. Paths whose ops can't all be anchored to a bar in the
-    # window fall back to a per-path mini timeline below.
+    # red. Final paths of one frontier share long prefixes, so shared
+    # SEGMENTS (same endpoints + same resulting state) draw exactly
+    # once — the merge-lines role (report.clj:300-351) — which is what
+    # keeps "render ALL paths" readable. Paths whose ops can't all be
+    # anchored to a bar in the window fall back to a per-path mini
+    # timeline below.
     overlaid = 0
+    drawn_segs: set = set()
+    drawn_marks: set = set()
     for pi, (p, op_steps, pts) in enumerate(anchored):
         color = PATH_COLORS[pi % len(PATH_COLORS)]
         # a path may start with string "prologue" steps describing the
@@ -126,28 +200,40 @@ def render_analysis(history: Sequence[Op], analysis,
         prev = None
         for si, (step, (ax, ay)) in enumerate(zip(op_steps, pts)):
             dead = step.get("model") == "inconsistent"
-            # nudge per path so overlapping chains stay tellable
-            ax += (pi - len(anchored) / 2) * 3
+            state = _state_label(step.get("model"))
             if prev is None:
-                if prologue:
-                    # entry state from the prologue, at the first dot
-                    svg.text(ax, ay - 9 - 4 * pi,
-                             "from " + _state_label(
-                                 prologue[-1].get("model")),
+                entry = ("from " + _state_label(
+                    prologue[-1].get("model")) if prologue else None)
+                ekey = (round(ax), round(ay), entry)
+                if entry and ekey not in drawn_marks:
+                    # entry state from the prologue, at the first dot;
+                    # distinct entry states at the same anchor stack
+                    stacked = sum(1 for (mx, my, t) in drawn_marks
+                                  if (mx, my) == ekey[:2]
+                                  and isinstance(t, str)
+                                  and t.startswith("from "))
+                    drawn_marks.add(ekey)
+                    svg.text(ax, ay - 9 - 9 * stacked, entry,
                              size=8, fill=color, anchor="middle")
             else:
                 px, py_ = prev
-                svg.line(px, py_, ax, ay,
-                         stroke="#c0392b" if dead else color,
-                         width=1.4 if dead else 1.1)
-            # the model state this hop produced, beside the dot
-            svg.text(ax + 5, ay - 5,
-                     _state_label(step.get("model")), size=8,
-                     fill="#c0392b" if dead else color)
-            svg.circle(ax, ay, 3.4 if dead else 2.6,
-                       fill="#c0392b" if dead else color,
-                       title=f"{step.get('op')!r} -> "
-                             f"{step.get('model')!r}")
+                seg = (round(px), round(py_), round(ax), round(ay),
+                       state)
+                if seg not in drawn_segs:
+                    drawn_segs.add(seg)
+                    svg.line(px, py_, ax, ay,
+                             stroke="#c0392b" if dead else color,
+                             width=1.4 if dead else 1.1)
+            mark = (round(ax), round(ay), state)
+            if mark not in drawn_marks:
+                drawn_marks.add(mark)
+                # the model state this hop produced, beside the dot
+                svg.text(ax + 5, ay - 5, state, size=8,
+                         fill="#c0392b" if dead else color)
+                svg.circle(ax, ay, 3.4 if dead else 2.6,
+                           fill="#c0392b" if dead else color,
+                           title=f"{step.get('op')!r} -> "
+                                 f"{step.get('model')!r}")
             prev = (ax, ay)
 
     y = 52 + ROW_H * max(len(procs), 1)
@@ -194,16 +280,16 @@ def render_analysis(history: Sequence[Op], analysis,
     return out
 
 
-def _span_anchors(spans, prow, left: float, lane: float):
+def _span_anchors(spans, prow, X):
     """(process, f, value) -> (x, y) canvas anchor at the CENTER of
     that op's bar in the grid; registered under both the invoked and
     the completed value (final paths use back-filled values). Pending
     (still-open) spans win over completed ones with the same
     signature: final paths linearize pending calls."""
     anchors = {}          # key -> (x, y, was_pending)
-    for (p, f, inv_v, comp_v, r0, r1, typ) in spans:
+    for (p, f, inv_v, comp_v, t0, t1, typ) in spans:
         y = 40 + prow[p] * ROW_H + (ROW_H - 6) / 2 + 2
-        x = left + (r0 + r1) / 2 * lane
+        x = (X(t0) + X(t1)) / 2
         for value in {repr(inv_v), repr(comp_v)}:
             key = (repr(p), repr(f), value)
             prev = anchors.get(key)

@@ -74,7 +74,10 @@ strip() {
   case "$1" in
     *:*) f="${1%%:*}"
          if [ -e "$f" ]; then printf '%s' "$1"
-         else printf '%s' "${1#*:}"; fi ;;
+         else p="${1#*:}"
+              # the remote shell would unquote the path; do the same
+              case "$p" in "'"*"'") p="${p#\'}"; p="${p%\'}" ;; esac
+              printf '%s' "$p"; fi ;;
     *) printf '%s' "$1" ;;
   esac
 }
@@ -159,6 +162,18 @@ def test_upload_download(shim, tmp_path):
     r.download("n4", str(dst), str(back))
     assert back.read_text() == "cargo\n"
     assert any(l.startswith("scp") for l in shim.log_lines())
+
+
+def test_upload_remote_path_with_spaces(shim, tmp_path):
+    """scp's remote side word-splits through the remote shell; _dest
+    must quote the path (the shim unquotes like a remote shell)."""
+    (tmp_path / "my dir").mkdir()
+    src = tmp_path / "payload2"
+    src.write_text("x\n")
+    dst = tmp_path / "my dir" / "bin file"
+    r = SSHRemote()
+    r.upload("n5", str(src), str(dst))
+    assert dst.read_text() == "x\n"
 
 
 # --- the flagship loop over SSHRemote with a mid-run reconnect -------------
