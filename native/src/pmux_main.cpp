@@ -1,0 +1,270 @@
+/* ct_pmux — port-multiplexer / service-discovery daemon.
+ *
+ * The role of the reference's pmux (tools/pmux/pmux.cpp:501-647
+ * command surface; :834 main loop): every comdb2 host runs one pmux;
+ * databases REGISTER their service name and get (or publish) a port,
+ * clients GET the port for a service name instead of carrying
+ * host:port config. This is an independent thread-per-connection
+ * rewrite of the same line protocol over the in-tree SUT's stack:
+ *
+ *   reg <svc>          -> allocate (or return) a port from the range
+ *   get [/echo] <svc>  -> port, or -1 when unknown ("/echo" prefixes
+ *                         the reply with the service name, like the
+ *                         reference's cdb2api uses)
+ *   use <svc> <port>   -> publish a fixed port for <svc>
+ *   del <svc>          -> forget the assignment
+ *   used | list        -> dump "port svc" assignments
+ *   active             -> count of assignments
+ *   hello              -> ok (liveness)
+ *   help               -> usage
+ *   exit               -> shut the daemon down
+ *
+ * Assignments persist to a state file (-f) so a pmux restart keeps
+ * ports stable, like the reference's store. Mutating commands are
+ * accepted from loopback peers only (pmux.cpp disallowed_write): a
+ * remote can discover, never rebind.
+ */
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Pmux {
+    std::mutex mu;
+    std::map<std::string, int> ports;   /* svc -> port */
+    std::set<int> in_use;
+    int lo = 19000, hi = 19999;         /* allocation range */
+    std::string state_file;
+    bool stop = false;
+    int srv = -1;                       /* listen fd (exit wakes it) */
+};
+
+Pmux g;
+
+void save_locked() {
+    if (g.state_file.empty()) return;
+    std::string tmp = g.state_file + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "w");
+    if (!f) return;
+    for (const auto &kv : g.ports)
+        fprintf(f, "%d %s\n", kv.second, kv.first.c_str());
+    fclose(f);
+    rename(tmp.c_str(), g.state_file.c_str());
+}
+
+void load() {
+    if (g.state_file.empty()) return;
+    FILE *f = fopen(g.state_file.c_str(), "r");
+    if (!f) return;
+    int port;
+    char svc[512];
+    while (fscanf(f, "%d %511s", &port, svc) == 2) {
+        g.ports[svc] = port;
+        g.in_use.insert(port);
+    }
+    fclose(f);
+}
+
+int alloc_locked(const std::string &svc) {
+    auto it = g.ports.find(svc);
+    if (it != g.ports.end()) return it->second;
+    for (int p = g.lo; p <= g.hi; ++p) {
+        if (!g.in_use.count(p)) {
+            g.ports[svc] = p;
+            g.in_use.insert(p);
+            save_locked();
+            return p;
+        }
+    }
+    return -1;
+}
+
+bool local_peer(int fd) {
+    sockaddr_in a{};
+    socklen_t len = sizeof(a);
+    if (getpeername(fd, (sockaddr *)&a, &len) != 0) return false;
+    return ntohl(a.sin_addr.s_addr) == INADDR_LOOPBACK;
+}
+
+void reply(FILE *out, const std::string &s) {
+    fputs(s.c_str(), out);
+    fputc('\n', out);
+    fflush(out);
+}
+
+void serve(int fd) {
+    FILE *in = fdopen(fd, "r");
+    FILE *out = fdopen(dup(fd), "w");
+    if (!in || !out) {
+        if (in) fclose(in); else close(fd);
+        if (out) fclose(out);
+        return;
+    }
+    bool writable = local_peer(fd);
+    char *line = nullptr;
+    size_t cap = 0;
+    ssize_t n;
+    while ((n = getline(&line, &cap, in)) > 0) {
+        while (n > 0 && (line[n - 1] == '\n' || line[n - 1] == '\r'))
+            line[--n] = 0;
+        char *sav = nullptr;
+        char *cmd = strtok_r(line, " ", &sav);
+        if (!cmd) { reply(out, "-1 empty command"); continue; }
+        std::string c = cmd;
+        if (c == "reg" || c == "use" || c == "del" || c == "exit") {
+            if (!writable) {
+                reply(out, "-1 write from remote connection denied");
+                continue;
+            }
+        }
+        if (c == "reg") {
+            char *svc = strtok_r(nullptr, " ", &sav);
+            if (!svc) { reply(out, "-1 missing service"); continue; }
+            std::lock_guard<std::mutex> l(g.mu);
+            reply(out, std::to_string(alloc_locked(svc)));
+        } else if (c == "get") {
+            char *a = strtok_r(nullptr, " ", &sav);
+            bool echo = a && strcmp(a, "/echo") == 0;
+            char *svc = echo ? strtok_r(nullptr, " ", &sav) : a;
+            if (!svc) { reply(out, "-1 missing service"); continue; }
+            int port;
+            {
+                std::lock_guard<std::mutex> l(g.mu);
+                auto it = g.ports.find(svc);
+                port = it == g.ports.end() ? -1 : it->second;
+            }
+            reply(out, echo ? std::to_string(port) + " " + svc
+                            : std::to_string(port));
+        } else if (c == "use") {
+            char *svc = strtok_r(nullptr, " ", &sav);
+            char *ps = strtok_r(nullptr, " ", &sav);
+            if (!svc || !ps) { reply(out, "-1 usage: use svc port"); continue; }
+            int port = atoi(ps);
+            if (port <= 0) { reply(out, "-1 bad port"); continue; }
+            std::lock_guard<std::mutex> l(g.mu);
+            /* a port published by ANOTHER service must not silently
+             * alias — deleting either would free the port under the
+             * survivor and a later reg would double-assign it */
+            bool taken = false;
+            for (const auto &kv : g.ports)
+                if (kv.second == port && kv.first != svc) {
+                    reply(out, "-1 port in use by " + kv.first);
+                    taken = true;
+                    break;
+                }
+            if (taken) continue;
+            auto it = g.ports.find(svc);
+            if (it != g.ports.end()) g.in_use.erase(it->second);
+            g.ports[svc] = port;
+            g.in_use.insert(port);
+            save_locked();
+            reply(out, "0");
+        } else if (c == "del") {
+            char *svc = strtok_r(nullptr, " ", &sav);
+            if (!svc) { reply(out, "-1 missing service"); continue; }
+            std::lock_guard<std::mutex> l(g.mu);
+            auto it = g.ports.find(svc);
+            if (it == g.ports.end()) { reply(out, "-1 unknown service"); }
+            else {
+                g.in_use.erase(it->second);
+                g.ports.erase(it);
+                save_locked();
+                reply(out, "0");
+            }
+        } else if (c == "used" || c == "list") {
+            std::lock_guard<std::mutex> l(g.mu);
+            for (const auto &kv : g.ports)
+                reply(out, std::to_string(kv.second) + " " + kv.first);
+            reply(out, ".");
+        } else if (c == "active") {
+            std::lock_guard<std::mutex> l(g.mu);
+            reply(out, std::to_string(g.ports.size()));
+        } else if (c == "hello") {
+            reply(out, "0 ok");
+        } else if (c == "help") {
+            reply(out, "reg/get [/echo]/use/del/used/active/hello/exit");
+        } else if (c == "exit") {
+            reply(out, "0 exiting");
+            {
+                std::lock_guard<std::mutex> l(g.mu);
+                g.stop = true;
+                /* the main thread is parked in accept(); shutting the
+                 * listen socket down wakes it so the stop actually
+                 * takes effect now, not at the next connection */
+                if (g.srv >= 0) shutdown(g.srv, SHUT_RDWR);
+            }
+            break;
+        } else {
+            reply(out, "-1 unknown command, type 'help'");
+        }
+    }
+    free(line);
+    fclose(in);
+    fclose(out);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    int port = 5105;
+    for (int i = 1; i < argc; ++i) {
+        if (!strcmp(argv[i], "-p") && i + 1 < argc) port = atoi(argv[++i]);
+        else if (!strcmp(argv[i], "-r") && i + 2 < argc) {
+            g.lo = atoi(argv[++i]);
+            g.hi = atoi(argv[++i]);
+        } else if (!strcmp(argv[i], "-f") && i + 1 < argc) {
+            g.state_file = argv[++i];
+        } else {
+            fprintf(stderr,
+                    "usage: %s [-p port] [-r lo hi] [-f state_file]\n",
+                    argv[0]);
+            return 2;
+        }
+    }
+    load();
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_ANY);
+    a.sin_port = htons((uint16_t)port);
+    if (bind(srv, (sockaddr *)&a, sizeof(a)) != 0 ||
+        listen(srv, 64) != 0) {
+        perror("bind/listen");
+        return 1;
+    }
+    {
+        std::lock_guard<std::mutex> l(g.mu);
+        g.srv = srv;
+    }
+    for (;;) {
+        int fd = accept(srv, nullptr, nullptr);
+        {
+            std::lock_guard<std::mutex> l(g.mu);
+            if (g.stop) {
+                if (fd >= 0) close(fd);
+                break;
+            }
+        }
+        if (fd < 0) continue;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        std::thread(serve, fd).detach();
+    }
+    close(srv);
+    return 0;
+}

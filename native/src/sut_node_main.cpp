@@ -1523,12 +1523,14 @@ void serve_conn(int fd) {
 int main(int argc, char **argv) {
     Node &n = g_node;
     std::string peers;
+    std::string pmux_spec;      /* -M <pmux_port>:<service> */
     int initial_leader = 0;
     int c;
-    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:xLNBDTRh")) != -1) {
+    while ((c = getopt(argc, argv, "i:n:P:t:e:l:d:M:xLNBDTRh")) != -1) {
         switch (c) {
         case 'i': n.id = atoi(optarg); break;
         case 'n': peers = optarg; break;
+        case 'M': pmux_spec = optarg; break;
         case 'P': initial_leader = atoi(optarg); break;
         case 't': n.timeout_ms = atoi(optarg); break;
         case 'e': n.elect_ms = atoi(optarg); break;
@@ -1546,6 +1548,7 @@ int main(int argc, char **argv) {
                     "usage: %s -i id -n port0,port1,... [-P leader0] "
                     "[-t durable_timeout_ms] [-e elect_base_ms] "
                     "[-l lease_ms] [-d state_dir] "
+                    "[-M pmux_port:service] "
                     "[-x (no-fsync control)] [-N (no-durable)] "
                     "[-B (split-brain control)] "
                     "[-D (no-dedup control)] "
@@ -1685,6 +1688,48 @@ int main(int argc, char **argv) {
         listen(srv, 64) != 0) {
         perror("bind/listen");
         return 2;
+    }
+    /* pmux registration (-M <pmux_port>:<service>): publish this
+     * node's client port with the host's port multiplexer so clients
+     * can discover it by service name instead of carrying host:port
+     * config — the role every comdb2 instance plays against pmux
+     * (tools/pmux role; cdb2api resolves ports the same way). Retried
+     * in the background so a pmux that boots moments after the node
+     * still learns the port; failure is non-fatal (readiness probes
+     * catch an undiscoverable node). */
+    if (!pmux_spec.empty()) {
+        size_t colon = pmux_spec.find(':');
+        int pmux_port = colon == std::string::npos
+                            ? 0 : atoi(pmux_spec.c_str());
+        std::string svc = colon == std::string::npos
+                              ? "" : pmux_spec.substr(colon + 1);
+        if (pmux_port <= 0 || svc.empty()) {
+            /* a malformed spec must fail AT STARTUP — a background
+             * thread giving up after 10 s leaves a healthy-looking
+             * node that is permanently undiscoverable */
+            fprintf(stderr, "sut_node: -M wants <pmux_port>:<service>\n");
+            return 2;
+        }
+        int my_port = n.ports[n.id];
+        std::thread([pmux_port, svc, my_port]() {
+            std::string line = "use " + svc + " " +
+                               std::to_string(my_port) + "\n";
+            for (int attempt = 0; attempt < 50; attempt++) {
+                int fd = dial("127.0.0.1", pmux_port, 500);
+                if (fd >= 0) {
+                    bool ok = write(fd, line.c_str(), line.size()) ==
+                              (ssize_t)line.size();
+                    char buf[64];
+                    ok = ok && read(fd, buf, sizeof buf) > 0 &&
+                         buf[0] == '0';
+                    close(fd);
+                    if (ok) return;
+                }
+                std::this_thread::sleep_for(
+                    std::chrono::milliseconds(200));
+            }
+            fprintf(stderr, "sut_node: pmux registration failed\n");
+        }).detach();
     }
     /* every node runs senders; they idle unless this node leads */
     for (int peer = 0; peer < (int)n.ports.size(); peer++)

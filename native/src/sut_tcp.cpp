@@ -143,11 +143,13 @@ extern "C" {
 
 /* comdb2db-style cluster discovery (the role of cdb2api's comdb2db
  * config lookup, cdb2api.c:780-1000): "@<path>[#<dbname>]" names a
- * config file whose lines are "<dbname> host:port host:port ..."
+ * config file whose lines are "<dbname> host[:port] host[:port] ..."
  * ('#' comments). With no #dbname the first entry wins. Returns the
- * flattened "host:port,host:port" list, or "" when the file/db is
- * missing. */
-static std::string resolve_comdb2db(const char *spec) {
+ * flattened "host[:port],..." list (port-less entries resolve through
+ * pmux at open time), or "" when the file/db is missing. ``dbname_out``
+ * receives the matched database name. */
+static std::string resolve_comdb2db(const char *spec,
+                                    std::string *dbname_out) {
     std::string s(spec + 1);            /* past '@' */
     std::string want;
     size_t hash = s.rfind('#');
@@ -167,6 +169,7 @@ static std::string resolve_comdb2db(const char *spec) {
         int off = 0;
         if (sscanf(p, "%255s %n", name, &off) < 1) continue;
         if (!want.empty() && want != name) continue;
+        if (dbname_out != nullptr) *dbname_out = name;
         for (char *tok = strtok(p + off, " \t\r\n"); tok != nullptr;
              tok = strtok(nullptr, " \t\r\n")) {
             if (!out.empty()) out += ",";
@@ -178,10 +181,30 @@ static std::string resolve_comdb2db(const char *spec) {
     return out;
 }
 
+/* pmux port lookup (the cdb2api portmux_get role: a config entry
+ * WITHOUT :port resolves through that host's port multiplexer —
+ * tools/pmux serves "get <service>"). The pmux port comes from
+ * COMDB2_TPU_PMUX_PORT (default 5105); the service name is
+ * "sut/<dbname>". Returns -1 when pmux is unreachable or the service
+ * is unregistered. */
+static int pmux_get_port(const std::string &host,
+                         const std::string &svc) {
+    const char *env = getenv("COMDB2_TPU_PMUX_PORT");
+    int pmux_port = env != nullptr ? atoi(env) : 5105;
+    char reply[256];
+    std::string req = "get " + svc;
+    if (ct_tcp_request(host.c_str(), pmux_port, req.c_str(), 2000,
+                       reply, sizeof reply) < 0)
+        return -1;
+    int port = atoi(reply);
+    return port > 0 ? port : -1;
+}
+
 sut_tcp *sut_tcp_open(const char *target, unsigned seed) {
     std::string resolved;
+    std::string dbname = "sut";
     if (target != nullptr && target[0] == '@') {
-        resolved = resolve_comdb2db(target);
+        resolved = resolve_comdb2db(target, &dbname);
         if (resolved.empty()) return nullptr;
         target = resolved.c_str();
     }
@@ -196,11 +219,19 @@ sut_tcp *sut_tcp_open(const char *target, unsigned seed) {
             std::string node = s.substr(pos, c - pos);
             size_t colon = node.rfind(':');
             if (colon == std::string::npos) {
-                delete t;
-                return nullptr;
+                /* no port: the pmux indirection — ask this host's
+                 * port multiplexer where the service lives */
+                int port = pmux_get_port(node, "sut/" + dbname);
+                if (port < 0) {
+                    delete t;
+                    return nullptr;
+                }
+                t->hosts.push_back(node);
+                t->ports.push_back(port);
+            } else {
+                t->hosts.push_back(node.substr(0, colon));
+                t->ports.push_back(atoi(node.c_str() + colon + 1));
             }
-            t->hosts.push_back(node.substr(0, colon));
-            t->ports.push_back(atoi(node.c_str() + colon + 1));
         }
         pos = c + 1;
     }
