@@ -119,6 +119,35 @@ def test_exit_actually_stops_the_daemon(tmp_path):
         _kill(p)
 
 
+def test_concurrent_registrations_never_alias(tmp_path):
+    """20 clients registering distinct services concurrently must get
+    20 distinct ports (allocation races under the daemon's mutex)."""
+    import threading
+
+    (port,) = _free_ports(1)
+    p = _spawn_pmux(port)
+    got = {}
+    lock = threading.Lock()
+
+    def worker(i):
+        with PmuxClient(port=port) as c:
+            pt = c.reg(f"sut/svc{i}")
+        with lock:
+            got[i] = pt
+
+    try:
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(20)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(got) == 20
+        assert len(set(got.values())) == 20, sorted(got.values())
+    finally:
+        _kill(p)
+
+
 def test_assignments_persist_across_restart(tmp_path):
     (port,) = _free_ports(1)
     state = tmp_path / "pmux.state"
