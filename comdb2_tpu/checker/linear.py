@@ -111,6 +111,19 @@ def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
     op = packed.ops[r.op_index]
     cfgs = [linear_host.describe_config(mm, packed, c)
             for c in r.configs[:10]]
+    # final paths on the host path too — the reference's analysis
+    # always carries them on invalid (linear.clj:251-265); without
+    # this, small (below-host-threshold) histories rendered
+    # counterexample SVGs with no linearization orders at all
+    try:
+        from .counterexample import final_paths
+        info["paths"] = final_paths(mm, packed, r.pre_configs,
+                                    r.op_index)
+    except Exception as e:
+        # decoration never destroys the verdict — but a silently
+        # dropped decoration is how the no-orders-in-SVG bug hid;
+        # leave a diagnosable trace in the report
+        info["paths_error"] = repr(e)
     return Analysis(valid=False, op=op, op_index=r.op_index,
                     configs=cfgs, info=info)
 

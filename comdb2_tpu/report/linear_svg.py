@@ -191,8 +191,28 @@ def render_analysis(history: Sequence[Op], analysis,
     overlaid = 0
     drawn_segs: set = set()
     drawn_marks: set = set()
+    if anchored:
+        # hover interactivity (the reference highlights paths on
+        # hover, report.clj:540+): each path carries an invisible
+        # thick hit-polyline through ALL its anchors; hovering it
+        # halos the WHOLE path — which also disambiguates segments
+        # that several paths share (drawn once below)
+        svg.style(".cpath .hit{stroke-opacity:0}"
+                  ".cpath:hover .hit{stroke-opacity:.3}")
     for pi, (p, op_steps, pts) in enumerate(anchored):
         color = PATH_COLORS[pi % len(PATH_COLORS)]
+        if len(pts) >= 2:
+            order = " -> ".join(
+                _step_label(s.get("op"), s.get("model"))
+                for s in op_steps)
+            svg.open_group(**{"class": "cpath"})
+            # opacity=0 as a PRESENTATION attribute too: renderers
+            # that ignore embedded CSS must not draw a thick opaque
+            # band (browser :hover CSS still overrides it)
+            svg.polyline(pts, stroke=color, width=7, cls="hit",
+                         opacity=0,
+                         title=f"linearization order {pi}: {order}")
+            svg.close_group()
         # a path may start with string "prologue" steps describing the
         # entry state ("(state before N returns)")
         prologue = [s for s in p if s not in op_steps]

@@ -12,6 +12,11 @@ from typing import List, Optional, Sequence, Tuple
 from xml.sax.saxutils import escape, quoteattr
 
 
+def _attrs(attrs: dict) -> str:
+    return " ".join(f"{k.replace('_', '-')}={quoteattr(str(v))}"
+                    for k, v in attrs.items() if v is not None)
+
+
 class SVG:
     def __init__(self, width: int, height: int):
         self.width = width
@@ -19,8 +24,7 @@ class SVG:
         self.parts: List[str] = []
 
     def elem(self, tag: str, body: Optional[str] = None, **attrs):
-        a = " ".join(f"{k.replace('_', '-')}={quoteattr(str(v))}"
-                     for k, v in attrs.items() if v is not None)
+        a = _attrs(attrs)
         if body is None:
             self.parts.append(f"<{tag} {a}/>")
         else:
@@ -50,10 +54,25 @@ class SVG:
                   font_family=family)
 
     def polyline(self, pts: Sequence[Tuple[float, float]], stroke="#333",
-                 width=1.5):
+                 width=1.5, title=None, opacity=None, cls=None):
         p = " ".join(f"{round(x, 2)},{round(y, 2)}" for x, y in pts)
-        self.elem("polyline", points=p, fill="none", stroke=stroke,
-                  stroke_width=width)
+        body = f"<title>{escape(title)}</title>" if title else None
+        attrs = {"points": p, "fill": "none", "stroke": stroke,
+                 "stroke_width": width, "stroke_opacity": opacity}
+        if cls:
+            attrs["class"] = cls
+        self.elem("polyline", body, **attrs)
+
+    def style(self, css: str) -> None:
+        """Embed a stylesheet (hover interactivity — the reference's
+        counterexample SVGs highlight on hover, ``report.clj:540+``)."""
+        self.parts.append(f"<style>{css}</style>")
+
+    def open_group(self, **attrs) -> None:
+        self.parts.append(f"<g {_attrs(attrs)}>")
+
+    def close_group(self) -> None:
+        self.parts.append("</g>")
 
     def render(self) -> str:
         return (f'<svg xmlns="http://www.w3.org/2000/svg" '

@@ -304,3 +304,41 @@ def test_50k_op_invalid_renders_all_paths_warped():
     assert dt < 10, dt                      # render itself is bounded
     assert "failed linearization orders" in svg
     assert "frontier died here" in svg
+
+
+def test_host_backend_invalid_carries_final_paths():
+    """The host engine's INVALID analyses carry final paths too (the
+    reference's analysis always does, linear.clj:251-265) — without
+    them, small below-threshold histories rendered counterexample SVGs
+    with no linearization orders at all (round-5 find)."""
+    h = [invoke(0, "write", 1), ok(0, "write", 1),
+         invoke(1, "read", None), ok(1, "read", 2)]
+    a = linear.analysis(M.register(), h, backend="host")
+    assert a.valid is False
+    assert a.info.get("backend") == "host"
+    paths = a.info.get("paths")
+    assert paths, a.info
+    for p in paths:
+        assert p[-1]["model"] == "inconsistent"
+    svg = linear_svg.render_analysis(h, a)
+    assert "failed linearization orders" in svg
+
+
+def test_counterexample_svg_hover_structure():
+    """Each anchored MULTI-STEP path carries an invisible hover
+    hit-polyline (the reference highlights paths on hover,
+    report.clj:540+); hovering halos the whole path, disambiguating
+    merged shared segments. (Single-step paths have nothing to halo.)
+    """
+    rng = random.Random(7)
+    h = register_history(rng, n_procs=5, n_events=60, p_info=0.0)
+    for p in range(100, 105):
+        h.append(invoke(p, "write", p % 5))
+    h.append(invoke(99, "read", None))
+    h.append(ok(99, "read", 77))
+    a = linear.analysis(M.cas_register(), h, backend="device")
+    assert a.valid is False
+    svg = linear_svg.render_analysis(h, a)
+    assert "<style>" in svg
+    assert 'class="cpath"' in svg and 'class="hit"' in svg
+    assert svg.count('class="cpath"') >= 5
