@@ -561,7 +561,7 @@ def _build_kernel(spec: SegKernelSpec):
 
         @pl.when(live)
         def _():
-            row, _, _ = _iotas(rows)
+            row, lane, _ = _iotas(rows)
             ws = [wsc[w][:] for w in range(W)]
             table = tab_ref[:]
             stride = off_ref[1]      # runtime table row stride
@@ -572,6 +572,22 @@ def _build_kernel(spec: SegKernelSpec):
                 tr = seg_ref[i, 2 + K + k]
                 m = frow & (ws[-1] < SENT_HI) & (p >= 0)
                 ws = _slot_add_runtime(spec, ws, p, tr + 1, m)
+
+            # --- lazy compaction (round 5): the ok filter no longer
+            # sorts survivors forward every segment — the frontier may
+            # enter SCATTERED across row 0. The full tier is
+            # scatter-proof (masked broadcast); only the mini tier
+            # needs the lanes-0..M-1 window, so compact exactly when a
+            # mini-sized frontier would otherwise miss it. In mini
+            # steady state (frontier stayed within the window) this
+            # removes one 28-stage sort per segment.
+            M = _mini_width(P)
+            extent = jnp.max(jnp.where(
+                frow & (ws[-1] < SENT_HI), lane + 1, 0))
+            ws = list(lax.cond(
+                (sstat[2] <= M) & (extent > M),
+                lambda a: tuple(_sort_row(list(a), rows)),
+                lambda a: a, tuple(ws)))
 
             # --- closure: bounded fixed point ------------------------
             # sstat[3]: continue flag, sstat[4]: overflow, sstat[5]: n
@@ -603,7 +619,7 @@ def _build_kernel(spec: SegKernelSpec):
                         ews = _sentinel(ews, row > 0)
                         return tuple(ews) + (n2,)
 
-                    use_mini = sstat[5] <= _mini_width(P)
+                    use_mini = sstat[5] <= M
                     out = lax.cond(use_mini, mini, full, tuple(cws))
                     ews, n2 = list(out[:W]), out[W]
                     ovf = (n2 > F).astype(jnp.int32)
@@ -638,10 +654,10 @@ def _build_kernel(spec: SegKernelSpec):
             ws = _slot_add_runtime(spec, ws, ok_p, 1, returned)
             ws = _sentinel(ws, frow & ~returned)
             n2 = jnp.sum(returned.astype(jnp.int32))
-            # re-compact row 0 (survivors are a scatter of the closed
-            # frontier): one row sort keeps the "frontier contiguous
-            # from lane 0" invariant the mini tier relies on
-            ws = _sort_row(ws, rows)
+            # survivors stay SCATTERED in row 0 — the next segment
+            # compacts lazily only if its mini tier needs the window
+            # (see the closure-entry cond above); unconditional
+            # re-sorting here cost 28 stages on every segment
 
             ovf = sstat[4] == 1
             st_new = jnp.where(ovf, UNKNOWN,

@@ -199,20 +199,15 @@ def render_analysis(history: Sequence[Op], analysis,
         # that several paths share (drawn once below)
         svg.style(".cpath .hit{stroke-opacity:0}"
                   ".cpath:hover .hit{stroke-opacity:.3}")
+    hit_bands = []            # emitted AFTER the visible marks: the
     for pi, (p, op_steps, pts) in enumerate(anchored):
         color = PATH_COLORS[pi % len(PATH_COLORS)]
-        if len(pts) >= 2:
-            order = " -> ".join(
+        if len(pts) >= 2:     # hit band must be topmost or hovering
+            order = " -> ".join(  # exactly ON a mark never triggers it
                 _step_label(s.get("op"), s.get("model"))
                 for s in op_steps)
-            svg.open_group(**{"class": "cpath"})
-            # opacity=0 as a PRESENTATION attribute too: renderers
-            # that ignore embedded CSS must not draw a thick opaque
-            # band (browser :hover CSS still overrides it)
-            svg.polyline(pts, stroke=color, width=7, cls="hit",
-                         opacity=0,
-                         title=f"linearization order {pi}: {order}")
-            svg.close_group()
+            hit_bands.append(
+                (pts, color, f"linearization order {pi}: {order}"))
         # a path may start with string "prologue" steps describing the
         # entry state ("(state before N returns)")
         prologue = [s for s in p if s not in op_steps]
@@ -255,6 +250,15 @@ def render_analysis(history: Sequence[Op], analysis,
                            title=f"{step.get('op')!r} -> "
                                  f"{step.get('model')!r}")
             prev = (ax, ay)
+
+    for pts, color, title in hit_bands:
+        svg.open_group(**{"class": "cpath"})
+        # opacity=0 as a PRESENTATION attribute too: renderers that
+        # ignore embedded CSS must not draw a thick opaque band
+        # (browser :hover CSS still overrides it)
+        svg.polyline(pts, stroke=color, width=7, cls="hit", opacity=0,
+                     title=title)
+        svg.close_group()
 
     y = 52 + ROW_H * max(len(procs), 1)
     if overlaid:

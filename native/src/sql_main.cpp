@@ -2,13 +2,19 @@
  * (the cdb2sql role, tools/cdb2sql in the reference).
  *
  * Usage:
- *   ct_sql host:port[,host:port...] [-c "sql"]... [-t timeout_ms]
+ *   ct_sql host[:port][,host[:port]...] [-c "sql"]... [-t timeout_ms]
+ *          [-s service]
  *
  * With -c, runs each statement and exits (exit 1 on ERR/FAIL/UNKNOWN
  * in any reply); otherwise reads one statement per line from stdin
  * and prints the server's reply. The server parses the SQL
  * (sql_front.cpp) — this shell is wire-dumb on purpose: implementation
  * diversity against the Python clients ends at the socket.
+ *
+ * An entry WITHOUT :port resolves through that host's port
+ * multiplexer (ct_pmux; the cdb2sql/cdb2api portmux flow): the pmux
+ * port comes from COMDB2_TPU_PMUX_PORT (default 5105) and the service
+ * name from -s (default "sut/sut").
  *
  * Connects to the FIRST reachable node of the list and sticks to it
  * (a SQL session is per-connection: an open transaction cannot move
@@ -78,21 +84,29 @@ std::string request(int fd, const std::string &line) {
 int main(int argc, char **argv) {
     if (argc < 2) {
         fprintf(stderr,
-                "usage: %s host:port[,host:port...] [-c sql]... "
-                "[-t timeout_ms]\n",
+                "usage: %s host[:port][,host[:port]...] [-c sql]... "
+                "[-t timeout_ms] [-s service]\n"
+                "  port-less hosts resolve via that host's pmux "
+                "(COMDB2_TPU_PMUX_PORT, default 5105)\n",
                 argv[0]);
         return 2;
     }
     std::vector<std::string> stmts;
     int timeout_ms = 2000;
+    std::string service = "sut/sut";
     for (int i = 2; i < argc; ++i) {
         if (strcmp(argv[i], "-c") == 0 && i + 1 < argc)
             stmts.push_back(argv[++i]);
         else if (strcmp(argv[i], "-t") == 0 && i + 1 < argc)
             timeout_ms = atoi(argv[++i]);
+        else if (strcmp(argv[i], "-s") == 0 && i + 1 < argc)
+            service = argv[++i];
     }
 
-    /* first reachable node of the comma list */
+    /* first reachable node of the comma list; port-less entries
+     * resolve through the host's pmux */
+    const char *pmux_env = getenv("COMDB2_TPU_PMUX_PORT");
+    int pmux_port = pmux_env != nullptr ? atoi(pmux_env) : 5105;
     int fd = -1;
     std::string list = argv[1];
     size_t pos = 0;
@@ -103,9 +117,21 @@ int main(int argc, char **argv) {
                                             : comma - pos);
         pos = comma == std::string::npos ? std::string::npos : comma + 1;
         size_t colon = hp.rfind(':');
-        if (colon == std::string::npos) continue;
-        fd = dial(hp.substr(0, colon), atoi(hp.c_str() + colon + 1),
-                  timeout_ms);
+        std::string host;
+        int port = -1;
+        if (colon == std::string::npos) {
+            host = hp;
+            int pfd = dial(host, pmux_port, timeout_ms);
+            if (pfd < 0) continue;
+            std::string r = request(pfd, "get " + service);
+            close(pfd);
+            port = atoi(r.c_str());
+            if (port <= 0) continue;
+        } else {
+            host = hp.substr(0, colon);
+            port = atoi(hp.c_str() + colon + 1);
+        }
+        fd = dial(host, port, timeout_ms);
     }
     if (fd < 0) {
         fprintf(stderr, "ct_sql: no node reachable\n");

@@ -220,6 +220,36 @@ def main() -> None:
                 assert n_f == n2, f"{name} seed={seed}: {n_f} vs {n2}"
             c[name, "ok" if st == 0
               else ("inv" if st == 1 else "unk")] += 1
+            # renamed-slots stage: production always routes through
+            # slot renaming (round 5) — the kernel on REMAPPED
+            # segments must reproduce the raw-segment verdict exactly
+            # (renaming is a pure relabeling). The spec choice MUST
+            # mirror the driver exactly (linear._analyze_device:
+            # even-bucket only while the (8,128) tier serves it, raw
+            # count in the (16,128) tier) so the fuzz covers the
+            # production configs, odd P included.
+            segs_r, p_eff = LJ.remap_slots(segs)
+            p_eff = max(p_eff, 1)
+            P2 = max(p_eff + (p_eff & 1), 2)
+            P_r = P2 if P2 <= PS.ROWS - 1 else p_eff
+            if P_r <= 2 * PS.ROWS - 1:
+                rr = PS.check_device_pallas(
+                    succ, segs_r, n_states=bucket[0],
+                    n_transitions=bucket[1], P=P_r)
+                if rr is not None:
+                    assert rr[0] == st, \
+                        f"{name} seed={seed} renamed: {rr} vs {r}"
+                    if st != 0:
+                        # INVALID *and* UNKNOWN compare fail segments
+                        # (the script's contract): a renaming bug that
+                        # moves the overflow point must not hide
+                        # behind a matching unk verdict
+                        assert rr[1] == fa, \
+                            f"{name} seed={seed} renamed fail index"
+                    else:
+                        assert rr[2] == n_f, \
+                            f"{name} seed={seed} renamed count"
+                    c[name, "renamed"] += 1
             if st == 2:
                 # re-check UNKNOWNs through the XLA ladder at a wider
                 # frontier: a kernel bug masquerading as an F=128
@@ -284,6 +314,14 @@ def main() -> None:
     # the coverage floor scales with the requested seed count (small
     # runs legitimately form few shared-table groups)
     assert n_streamed > n // 3
+    # renamed-slots coverage floor: a remap/spec change that silently
+    # drops most seeds out of the stage must fail the fuzz, not emit a
+    # PASS artifact advertising coverage it no longer has
+    n_renamed = sum(c[nm, "renamed"] for nm in names)
+    n_device = sum(c[nm, k] for nm in names for k in ("ok", "inv",
+                                                      "unk"))
+    assert n_renamed >= (2 * n_device) // 3, \
+        f"renamed-slots coverage {n_renamed}/{n_device}"
 
     if out_path:
         import jax
@@ -299,9 +337,11 @@ def main() -> None:
             "total_cross_checked": int(sum(
                 c[nm, k] for nm in names
                 for k in ("ok", "inv", "unk"))),
+            "renamed_slots_cross_checked": int(n_renamed),
             "stream_histories_cross_checked": n_streamed,
             "engines": ["pallas-fused", "xla-seg",
-                        "pallas-fused-stream"],
+                        "pallas-fused-stream",
+                        "pallas-fused-renamed-slots"],
             "backend": jax.default_backend(),
             "verdict": "PASS",   # any mismatch asserts before this
         }

@@ -333,3 +333,47 @@ def test_interpret_stream_renamed_slots_matches_host(interpret_kernel):
             assert g[2] == hr.final_count, (g, hr)
         else:
             assert int(segs_list[i].seg_index[g[1]]) == hr.op_index
+
+
+def test_interpret_lazy_compaction_scattered_frontier(interpret_kernel):
+    """The round-5 lazy-compaction path, DETERMINISTICALLY: a frontier
+    that grows past the mini window M (full tier), gets filtered down
+    to a mini-sized SCATTERED set by ok filters, and must then be
+    compacted at closure entry for the mini tier to read it. Five
+    concurrent distinct writes give an 81-config closure at the first
+    ok (M = 128//(P+1) = 18 at P=6), shrinking through the remaining
+    oks — verdict and final count must match the host engine exactly.
+    A wrong entry-compaction cond (e.g. stale count, >= vs >) breaks
+    the count or flips the verdict here, not just on lucky fuzz seeds.
+    """
+    from comdb2_tpu.checker import linear_host
+    from comdb2_tpu.models.memo import memo as make_memo
+
+    h = []
+    k = 5
+    for p in range(k):
+        h.append(O.invoke(p, "write", p))
+    for p in range(k):
+        h.append(O.ok(p, "write", p))
+    # a tail of small segments AFTER the shrink: these are the
+    # segments that enter with a mini-sized scattered frontier
+    for i in range(6):
+        p = i % 2
+        h.append(O.invoke(p, "write", i % k))
+        h.append(O.ok(p, "write", i % k))
+    packed = pack_history(h)
+    mm = make_memo(M.cas_register(), packed)
+    segs = LJ.make_segments(packed, s_pad=16, k_pad=8)
+    P = len(packed.process_table)
+    # structural precondition: the history really exercises the path —
+    # host per-segment frontier must cross above M then return <= M
+    hr = linear_host.check(mm, packed, max_configs=1 << 16)
+    assert hr.valid
+    M_mini = 128 // (P + 1)
+    assert hr.max_frontier > M_mini, (hr.max_frontier, M_mini)
+    succ = LJ.pad_succ(mm.succ, 8, 8)
+    r = PS.check_device_pallas(succ, segs, n_states=8,
+                               n_transitions=8, P=P)
+    assert r is not None
+    assert r[0] == LJ.VALID, r
+    assert r[2] == hr.final_count, (r, hr.final_count)

@@ -119,6 +119,30 @@ def test_exit_actually_stops_the_daemon(tmp_path):
         _kill(p)
 
 
+def test_protocol_garbage_does_not_crash(tmp_path):
+    """Binary garbage, oversized lines, and half-commands must get ERR
+    replies (or closed connections) — never a daemon crash."""
+    (port,) = _free_ports(1)
+    p = _spawn_pmux(port)
+    try:
+        for payload in (b"\x00\xff\xfe garbage\n", b"reg\n", b"get\n",
+                        b"use onlysvc\n", b"del\n",
+                        b"A" * 100_000 + b"\n", b"\n"):
+            s = socket.create_connection(("127.0.0.1", port), timeout=2)
+            s.sendall(payload)
+            try:
+                r = s.recv(256)
+                assert r == b"" or r.startswith(b"-1"), (payload[:20], r)
+            finally:
+                s.close()
+        # still alive and serving
+        with PmuxClient(port=port) as c:
+            assert c.hello()
+        assert p.poll() is None
+    finally:
+        _kill(p)
+
+
 def test_concurrent_registrations_never_alias(tmp_path):
     """20 clients registering distinct services concurrently must get
     20 distinct ports (allocation races under the daemon's mutex)."""
@@ -195,6 +219,44 @@ def test_sut_node_registers_and_python_resolves(tmp_path):
         f.flush()
         assert f.readline().strip() == "PONG"
         s.close()
+    finally:
+        _kill(sn)
+        _kill(pm)
+
+
+def test_ct_sql_resolves_via_pmux(tmp_path):
+    """ct_sql with a PORT-LESS host entry resolves through pmux (the
+    cdb2sql portmux flow) and runs SQL against the discovered node."""
+    ct_sql = os.path.join(BUILD, "ct_sql")
+    if not (os.path.exists(ct_sql) and os.path.exists(SUT)):
+        pytest.skip("native artifacts not built")
+    pmux_port, node_port = _free_ports(2)
+    pm = _spawn_pmux(pmux_port)
+    sn = subprocess.Popen(
+        [SUT, "-i", "0", "-n", str(node_port), "-P", "0",
+         "-e", "500", "-l", "300",
+         "-M", f"{pmux_port}:sut/sqldb"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _await_port(node_port)
+        with PmuxClient(port=pmux_port) as c:
+            deadline = time.monotonic() + 10
+            while c.get("sut/sqldb") is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        env = {**os.environ, "COMDB2_TPU_PMUX_PORT": str(pmux_port)}
+        r = subprocess.run(
+            [ct_sql, "127.0.0.1", "-s", "sut/sqldb",
+             "-c", "insert into register (id, val) values (1, 6)",
+             "-c", "select val from register where id = 1"],
+            capture_output=True, text=True, env=env, timeout=20)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert r.stdout.splitlines() == ["ROWS 1", "V 6"], r.stdout
+        # unregistered service: clean failure, not a hang
+        r2 = subprocess.run(
+            [ct_sql, "127.0.0.1", "-s", "sut/none", "-c", "begin"],
+            capture_output=True, text=True, env=env, timeout=20)
+        assert r2.returncode == 2, (r2.stdout, r2.stderr)
     finally:
         _kill(sn)
         _kill(pm)
