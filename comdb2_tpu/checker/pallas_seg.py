@@ -53,7 +53,16 @@ logger = logging.getLogger(__name__)
 ROWS, LANES = 8, 128
 N = ROWS * LANES          # flat sort width
 F = LANES                 # frontier capacity (row 0)
-CHUNK = 1024              # segments per kernel call (SMEM-bounded)
+CHUNK = 1024              # segments per kernel call. SMEM-bounded in
+                          # TWO ways: the scalar-prefetch array
+                          # (~14336 int32) AND a per-grid-step SMEM
+                          # cost (~500 B/step toward the 1 MB space) —
+                          # a 2048-step grid fails Mosaic compile with
+                          # "Exceeded smem capacity" even at width 4,
+                          # while 1408 steps compile. 1024 is the
+                          # known-good cap; raising it bought ~noise
+                          # (+1.5% on the 50k bench, within tunnel
+                          # variance) before hitting the wall.
 CHUNK_INTERPRET = 16      # interpret mode unrolls the grid at trace
                           # time — a 1024-step chunk would trace 1024
                           # kernel bodies
