@@ -614,23 +614,34 @@ def _build_kernel(spec: SegKernelSpec):
                         ews = _expand(spec, table, stride, list(args))
                         ews = _sort_flat(ews, rows)
                         ews, n2 = _dedup_count(ews, rows)
-                        return tuple(ews) + (n2,)
+                        # flat extent of the deduped survivors: when
+                        # they all sit in row 0 already, the next full
+                        # iteration (masked row-0 broadcast) needs no
+                        # compaction sort
+                        _, _, flat = _iotas(rows)
+                        ext = jnp.max(jnp.where(ews[-1] < SENT_HI,
+                                                flat + 1, 0))
+                        return tuple(ews) + (n2, ext)
 
                     def mini(args):
                         # frontier fits one lane group (128/(P+1)
                         # lanes): the whole iteration stays in row 0
                         # and the sorts are 28 lane-only stages
-                        # instead of the full flat ones
+                        # instead of the full flat ones. Extent LANES+1
+                        # forces the (row) compaction: dedup holes may
+                        # leave survivors beyond the M-lane window the
+                        # next mini read needs.
                         ews = _mini_expand(spec, table, stride,
                                            list(args))
                         ews = _sort_row(ews, rows)
                         ews, n2 = _dedup_count_row(ews, rows)
                         ews = _sentinel(ews, row > 0)
-                        return tuple(ews) + (n2,)
+                        return tuple(ews) + (n2,
+                                             jnp.int32(LANES + 1))
 
                     use_mini = sstat[5] <= M
                     out = lax.cond(use_mini, mini, full, tuple(cws))
-                    ews, n2 = list(out[:W]), out[W]
+                    ews, n2, ext = list(out[:W]), out[W], out[W + 1]
                     ovf = (n2 > F).astype(jnp.int32)
                     changed = (n2 > sstat[5]).astype(jnp.int32)
                     sstat[4] = sstat[4] | ovf
@@ -646,10 +657,20 @@ def _build_kernel(spec: SegKernelSpec):
                             args[:W])
 
                     # no growth => the deduped union IS the previous
-                    # frontier; restore it and skip the compaction sort
-                    return lax.cond(changed == 1, compact2,
-                                    lambda a: tuple(cws),
-                                    tuple(ews) + (use_mini,))
+                    # frontier; restore it. Growth with every survivor
+                    # already in row 0 (full tier, ext <= LANES) =>
+                    # skip the compaction sort too — the next full
+                    # iteration and the ok filter are both
+                    # sentinel-mask-based over row 0
+                    need_sort = (changed == 1) & \
+                        (use_mini | (ext > LANES))
+                    return lax.cond(
+                        need_sort, compact2,
+                        lambda a: lax.cond(changed == 1,
+                                           lambda b: b[:W],
+                                           lambda b: tuple(cws),
+                                           a),
+                        tuple(ews) + (use_mini,))
 
                 return lax.cond(sstat[3] == 1, run, lambda a: a,
                                 tuple(cws))
