@@ -76,6 +76,27 @@ def main() -> None:
     bench("flat B=1", lambda: LJ.check_device_flat(
         succ, ip, it, op, segs.depth, B=1, F=F, P=P, **sizes)[0], lane0)
 
+    # the production path: the fused Pallas kernel on slot-renamed
+    # segments, at the driver's exact tier choice (even-bucket only
+    # while the (8,128) tier serves it — linear._analyze_device)
+    from comdb2_tpu.checker import pallas_seg as PSEG
+
+    segs_r, p_eff = LJ.remap_slots(segs)
+    p_eff = max(p_eff, 1)
+    P2 = max(p_eff + (p_eff & 1), 2)
+    P_k = P2 if P2 <= PSEG.ROWS - 1 else p_eff
+    fused_ok = (PSEG.available()
+                and PSEG.spec_for(sizes["n_states"],
+                                  sizes["n_transitions"], P_k, K)
+                is not None)
+    if fused_ok:
+        bench("pallas-fused (renamed)",
+              lambda: PSEG.check_device_pallas(
+                  mm.succ, segs_r, P=P_k, **sizes)[0], single)
+    else:
+        print("pallas-fused            unavailable for this "
+              "backend/shape", flush=True)
+
 
 if __name__ == "__main__":
     main()
