@@ -1,0 +1,138 @@
+"""Repo-wide static invariant checker — ``python -m comdb2_tpu.analysis``.
+
+The framework's fragile invariants (exact sort-adjacency dedup,
+sentinel-mask frontier reads, (8,128) tiling, SMEM-per-grid-step
+budgets, shape bucketing) historically lived as prose in CLAUDE.md and
+were rediscovered via 40 s Mosaic compile failures or 38-minute wedged
+test suites. This package checks them *before* compile time, as three
+cooperating passes:
+
+- :mod:`.lint` — AST lint rules over ``comdb2_tpu/``, ``scripts/`` and
+  ``tests/`` (JAX env config after import, multiprocessing pools,
+  hash-fingerprint dedup, duplicated closures under nested
+  ``lax.cond``, EDN/history hygiene).
+- :mod:`.pallas_budget` — static Pallas/Mosaic resource budgeting:
+  every production ``spec_for`` tier is re-derived and checked against
+  the measured v5e limits (SMEM prefetch <= ~56 KB, ~500 B of SMEM per
+  grid step toward the 1 MB space, (8,128) block divisibility, K <= 8,
+  F = 128), plus an AST scan of ``pallas_call`` sites for
+  literally-bad configs.
+- :mod:`.jaxpr_audit` — recompile-hazard analysis: the declared shape
+  buckets must be closed (no unbucketed shape reaches a jit boundary
+  from the fuzz script or the driver), and the engine entry points are
+  abstractly traced per bucket to flag duplicated sub-jaxprs under
+  ``cond`` branches (the CPU compile-time explosion of round 3).
+
+Per-line suppression: append ``# analysis: ignore[rule-id]`` (or a
+blanket ``# analysis: ignore``) to the flagged line. Each rule's
+provenance is documented in ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+#: directories (relative to the repo root) the default repo scan covers
+SCAN_ROOTS = ("comdb2_tpu", "scripts", "tests")
+
+#: path fragments excluded from the default scan (seeded-violation
+#: fixtures live under tests/fixtures/ and MUST fail the checker when
+#: passed explicitly — and must not fail the repo scan)
+EXCLUDE_PARTS = ("fixtures",)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: ``rule path:line message``."""
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.rule} {self.path}:{self.line} {self.message}"
+
+
+def repo_root() -> str:
+    """The repository root (parent of the ``comdb2_tpu`` package)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def suppressed(source_lines: Sequence[str], lineno: int,
+               rule: str) -> bool:
+    """True when ``lineno`` (1-based) carries an
+    ``# analysis: ignore[rule]`` or blanket ``# analysis: ignore``
+    marker."""
+    if not (1 <= lineno <= len(source_lines)):
+        return False
+    line = source_lines[lineno - 1]
+    if "analysis: ignore" not in line:
+        return False
+    marker = line.split("analysis: ignore", 1)[1]
+    if marker.startswith("["):
+        inside = marker[1:marker.index("]")] if "]" in marker else ""
+        return rule in {r.strip() for r in inside.split(",")}
+    return True
+
+
+def collect_files(root: Optional[str] = None) -> List[str]:
+    """All ``.py`` files under :data:`SCAN_ROOTS`, fixtures excluded."""
+    root = root or repo_root()
+    out: List[str] = []
+    for sub in SCAN_ROOTS:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in EXCLUDE_PARTS
+                           and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def run_paths(paths: Iterable[str]) -> List[Finding]:
+    """Run every file-level pass (lint + budget AST + jaxpr AST) over
+    explicit paths — the mode seeded-violation fixtures use."""
+    from . import jaxpr_audit, lint, pallas_budget
+
+    paths = list(paths)
+    findings: List[Finding] = []
+    for p in paths:
+        findings += lint.lint_file(p)
+    findings += pallas_budget.scan_files(paths)
+    findings += jaxpr_audit.scan_files(paths)
+    return findings
+
+
+def run_repo(root: Optional[str] = None, *,
+             trace: bool = True) -> List[Finding]:
+    """The full repo-wide run: lint over the scan roots, the
+    production Pallas budget table, and the jaxpr recompile audit
+    (bucket-closure scan of the fuzz script and the driver, plus —
+    with ``trace`` — abstract traces of the engine entry points)."""
+    from . import jaxpr_audit, lint, pallas_budget
+
+    root = root or repo_root()
+    files = collect_files(root)
+    findings: List[Finding] = []
+    for p in files:
+        findings += lint.lint_file(p)
+    findings += pallas_budget.scan_files(files)
+    findings += pallas_budget.check_production()
+    findings += jaxpr_audit.scan_files(
+        [os.path.join(root, "scripts", "fuzz_pallas_seg.py"),
+         os.path.join(root, "comdb2_tpu", "checker", "linear.py")])
+    findings += jaxpr_audit.check_bucket_closure()
+    if trace:
+        findings += jaxpr_audit.trace_entry_points()
+    return findings
+
+
+__all__ = ["Finding", "SCAN_ROOTS", "collect_files", "repo_root",
+           "run_paths", "run_repo", "suppressed"]

@@ -1,0 +1,63 @@
+"""CLI: ``python -m comdb2_tpu.analysis [paths...]``.
+
+With no paths: the full repo-wide run (lint over comdb2_tpu/, scripts/
+and tests/; production Pallas budgets; jaxpr recompile audit). With
+explicit paths: the file-level passes only — the mode the seeded
+violation fixtures (tests/fixtures/analysis/) use.
+
+Exits non-zero when any finding survives suppression; each finding
+prints as ``rule-id path:line message``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from . import Finding, run_paths, run_repo
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m comdb2_tpu.analysis",
+        description="repo-wide static invariant checker")
+    p.add_argument("paths", nargs="*",
+                   help="explicit files to check (default: whole repo)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip the jaxpr abstract-trace stage")
+    p.add_argument("--budget-table", metavar="PATH",
+                   help="write the checked Pallas budget table "
+                        "artifact (markdown) and continue")
+    p.add_argument("--json", metavar="PATH", dest="json_out",
+                   help="also write findings as JSON")
+    args = p.parse_args(argv)
+
+    if args.budget_table:
+        from . import pallas_budget
+
+        with open(args.budget_table, "w") as fh:
+            fh.write(pallas_budget.budget_table())
+        print(f"budget table written: {args.budget_table}")
+
+    findings: List[Finding]
+    if args.paths:
+        findings = run_paths(args.paths)
+    else:
+        findings = run_repo(trace=not args.no_trace)
+
+    for f in findings:
+        print(f.format())
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump([f.__dict__ for f in findings], fh, indent=1)
+    if findings:
+        print(f"FAIL: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("OK: 0 findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,347 @@
+"""AST lint rules for the codified CLAUDE.md invariants.
+
+Each rule carries its provenance in ``docs/static_analysis.md``; the
+short story per rule id:
+
+- ``jax-env-after-import`` — the ambient interpreter-startup hook
+  pre-imports jax, so JAX/XLA env vars written after a jax import are
+  read too late (the platform silently stays on the tunneled TPU and a
+  90 s suite takes 38 min in ``ep_poll``). Use ``jax.config.update``.
+- ``no-multiprocessing`` — the container exposes ONE CPU; a spawn pool
+  measured 322 s -> 566 s on the 4096x generation (pure IPC overhead).
+- ``hash-dedup`` — device-checker dedup must be EXACT
+  (sort-adjacency); hash-fingerprint ordering lets colliding
+  non-identical rows break adjacency and balloon the frontier.
+- ``dup-cond-closure`` — inlining the same closure body under two
+  branches of nested ``lax.cond`` makes XLA compile the body per
+  branch path; CPU compile time explodes. Run the shared tier
+  unconditionally and select with ONE cond.
+- ``keyed-history-wrap`` — EDN ``[k v]`` values parse as plain tuples
+  (a bare 2-tuple is a cas pair); modules that parse histories and
+  check them must route keyed histories through
+  ``independent.wrap_keyed_history``.
+- ``nemesis-info-completion`` — nemesis completions must stay type
+  ``info`` (PassThrough client) or ``history.complete`` rejects the
+  history; an ok/fail completion would let the nemesis affect the
+  model.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding, suppressed
+
+JAX_ENV_PREFIXES = ("JAX_", "XLA_")
+
+CHECKER_ENTRY_NAMES = {"analysis", "check_history"}
+PARSE_NAMES = {"parse_history", "parse_history_fast"}
+
+
+def _name_of(node: ast.AST) -> str:
+    """Trailing identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and _name_of(node.value) == "os")
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _ModuleInfo(ast.NodeVisitor):
+    """One traversal collecting everything the rules need."""
+
+    def __init__(self) -> None:
+        self.jax_import_line: Optional[int] = None   # module level
+        self.imports_jax = False                     # anywhere
+        self.mp_imports: List[Tuple[int, str]] = []
+        self.hash_uses: List[int] = []
+        self.env_writes: List[Tuple[int, str, bool]] = []  # ln, key, in_fn
+        self.parse_calls: List[int] = []
+        self.checker_calls: List[int] = []
+        self.wrap_refs = 0
+        self.nemesis_bad_type: List[Tuple[int, str]] = []
+        self.cond_calls: List[ast.Call] = []
+        self.func_defs: Dict[str, ast.AST] = {}
+        self._fn_depth = 0
+
+    # -- imports -------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            top = a.name.split(".")[0]
+            if top == "jax":
+                self.imports_jax = True
+                if self._fn_depth == 0 and self.jax_import_line is None:
+                    self.jax_import_line = node.lineno
+            if top == "multiprocessing":
+                self.mp_imports.append((node.lineno, a.name))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        top = (node.module or "").split(".")[0]
+        if top == "jax":
+            self.imports_jax = True
+            if self._fn_depth == 0 and self.jax_import_line is None:
+                self.jax_import_line = node.lineno
+        if top == "multiprocessing":
+            self.mp_imports.append((node.lineno, node.module or top))
+        if top == "concurrent":
+            for a in node.names:
+                if a.name == "ProcessPoolExecutor":
+                    self.mp_imports.append((node.lineno, a.name))
+        self.generic_visit(node)
+
+    # -- defs / scoping ------------------------------------------------
+
+    def _visit_fn(self, node) -> None:
+        self.func_defs.setdefault(node.name, node)
+        self._fn_depth += 1
+        self.generic_visit(node)
+        self._fn_depth -= 1
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- expressions ---------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (node.attr == "ProcessPoolExecutor"
+                and _name_of(node.value) == "futures"):
+            self.mp_imports.append((node.lineno, "ProcessPoolExecutor"))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id == "wrap_keyed_history":
+            self.wrap_refs += 1
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if (isinstance(t, ast.Subscript)
+                    and _is_os_environ(t.value)):
+                key = _const_str(t.slice)
+                if key and key.startswith(JAX_ENV_PREFIXES):
+                    self.env_writes.append(
+                        (node.lineno, key, self._fn_depth > 0))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = _name_of(fn)
+        if isinstance(fn, ast.Name) and name == "hash":
+            self.hash_uses.append(node.lineno)
+        if name == "setdefault" and isinstance(fn, ast.Attribute) \
+                and _is_os_environ(fn.value) and node.args:
+            key = _const_str(node.args[0])
+            if key and key.startswith(JAX_ENV_PREFIXES):
+                self.env_writes.append(
+                    (node.lineno, key, self._fn_depth > 0))
+        if name in PARSE_NAMES:
+            self.parse_calls.append(node.lineno)
+        if name in CHECKER_ENTRY_NAMES:
+            self.checker_calls.append(node.lineno)
+        if name == "wrap_keyed_history":
+            self.wrap_refs += 1
+        if name in ("cond", "switch") \
+                and _name_of(getattr(fn, "value", None)) in ("lax",
+                                                            "jax"):
+            self.cond_calls.append(node)
+        # nemesis completion types: Op(..., type="ok"/"fail"),
+        # op.with_(type=...), and {**op, "type": "ok"} dict displays
+        # are caught in _nemesis_scan (dict displays aren't calls)
+        if name in ("Op", "with_"):
+            for kw in node.keywords:
+                if kw.arg == "type":
+                    v = _const_str(kw.value)
+                    if v in ("ok", "fail"):
+                        self.nemesis_bad_type.append((node.lineno, v))
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        for k, v in zip(node.keys, node.values):
+            if k is not None and _const_str(k) == "type":
+                val = _const_str(v)
+                if val in ("ok", "fail"):
+                    self.nemesis_bad_type.append((node.lineno, val))
+        self.generic_visit(node)
+
+
+def _hash_args(node: ast.Call) -> List[int]:
+    """Lines where builtin ``hash`` is passed as a sort key
+    (``key=hash``) — dedup by hash without even a call."""
+    out = []
+    for kw in node.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name) \
+                and kw.value.id == "hash":
+            out.append(node.lineno)
+    return out
+
+
+def _branches(call: ast.Call) -> List[ast.AST]:
+    """Branch callables of a lax.cond/lax.switch call node."""
+    name = _name_of(call.func)
+    if name == "cond":
+        return list(call.args[1:3])
+    if name == "switch" and len(call.args) >= 2 \
+            and isinstance(call.args[1], (ast.List, ast.Tuple)):
+        return list(call.args[1].elts)
+    return []
+
+
+def _branch_key(branch: ast.AST,
+                defs: Dict[str, ast.AST]) -> Optional[str]:
+    """Structural fingerprint of a branch body; None for trivial
+    branches (no call in the body — pass-through lambdas legitimately
+    repeat)."""
+    body: Optional[ast.AST] = None
+    if isinstance(branch, ast.Lambda):
+        body = branch.body
+    elif isinstance(branch, ast.Name) and branch.id in defs:
+        body = ast.Module(body=defs[branch.id].body, type_ignores=[])
+    if body is None:
+        return None
+    if not any(isinstance(n, ast.Call) for n in ast.walk(body)):
+        return None
+    return ast.dump(body)
+
+
+def _cond_subtree(call: ast.Call,
+                  defs: Dict[str, ast.AST]) -> set:
+    """Node-identity set of the cond call's subtree, with Name
+    branches resolved to their local function definitions (so a cond
+    inside a named branch counts as nested under this cond)."""
+    nodes = set(map(id, ast.walk(call)))
+    for br in _branches(call):
+        if isinstance(br, ast.Name) and br.id in defs:
+            nodes |= set(map(id, ast.walk(defs[br.id])))
+    return nodes
+
+
+def _dup_cond_findings(info: _ModuleInfo, path: str,
+                       lines) -> List[Finding]:
+    conds = info.cond_calls
+    out: List[Finding] = []
+    keyed = []
+    for c in conds:
+        keys = [(_branch_key(b, info.func_defs), b) for b in
+                _branches(c)]
+        keyed.append([k for k, _ in keys])
+        # same non-trivial body twice under ONE cond
+        seen = set()
+        for k, _ in keys:
+            if k is None:
+                continue
+            if k in seen:
+                out.append(Finding(
+                    "dup-cond-closure", path, c.lineno,
+                    "identical closure body under two branches of one "
+                    "lax.cond — hoist it and select inputs instead"))
+            seen.add(k)
+    subtrees = [_cond_subtree(c, info.func_defs) for c in conds]
+    for i, ci in enumerate(conds):
+        for j, cj in enumerate(conds):
+            if i == j or id(cj) not in subtrees[i]:
+                continue
+            dup = set(k for k in keyed[i] if k) \
+                & set(k for k in keyed[j] if k)
+            if dup:
+                out.append(Finding(
+                    "dup-cond-closure", path, cj.lineno,
+                    f"closure body duplicated between nested lax.cond "
+                    f"branches (outer at line {ci.lineno}): XLA "
+                    "compiles it once per branch path — run the "
+                    "shared tier unconditionally, select with ONE "
+                    "cond"))
+    return out
+
+
+def lint_file(path: str, source: Optional[str] = None) -> List[Finding]:
+    """All lint findings for one file (suppressions applied)."""
+    if source is None:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", path, e.lineno or 0, str(e))]
+    lines = source.splitlines()
+    info = _ModuleInfo()
+    info.visit(tree)
+
+    raw: List[Finding] = []
+
+    for ln, key, in_fn in info.env_writes:
+        after_import = (info.jax_import_line is not None
+                        and ln > info.jax_import_line)
+        if in_fn or after_import:
+            raw.append(Finding(
+                "jax-env-after-import", path, ln,
+                f"os.environ[{key!r}] written after jax import — jax "
+                "reads env only at import (the ambient hook may "
+                "pre-import it); use jax.config.update"))
+
+    for ln, what in info.mp_imports:
+        raw.append(Finding(
+            "no-multiprocessing", path, ln,
+            f"{what}: the container exposes ONE CPU — a spawn pool is "
+            "pure IPC overhead (measured 322 s -> 566 s); keep "
+            "host-side work single-process"))
+
+    if info.imports_jax:
+        hash_lines = list(info.hash_uses)
+        for c in ast.walk(tree):
+            if isinstance(c, ast.Call):
+                hash_lines += _hash_args(c)
+        for ln in sorted(set(hash_lines)):
+            raw.append(Finding(
+                "hash-dedup", path, ln,
+                "builtin hash() in a jax engine module — device "
+                "dedup must be EXACT (sort-adjacency), never "
+                "hash-fingerprint ordering"))
+        raw += _dup_cond_findings(info, path, lines)
+
+    parts = path.replace("\\", "/").split("/")
+    base = parts[-1]
+    # scoped to production code: tests parse histories THEY generated
+    # (known non-keyed); the hazard is entry points fed arbitrary EDN.
+    # Seeded fixtures under tests/fixtures/ are NOT exempt — they
+    # exist to trip the rules
+    in_tests = (base.startswith("test_")
+                or ("tests" in parts and "fixtures" not in parts))
+    if info.parse_calls and info.checker_calls and not info.wrap_refs \
+            and not in_tests:
+        raw.append(Finding(
+            "keyed-history-wrap", path, info.parse_calls[0],
+            "module parses EDN histories and runs a checker without "
+            "referencing independent.wrap_keyed_history — EDN [k v] "
+            "values parse as plain tuples (a bare 2-tuple is a cas "
+            "pair)"))
+
+    if "nemesis" in base:
+        for ln, val in info.nemesis_bad_type:
+            raw.append(Finding(
+                "nemesis-info-completion", path, ln,
+                f"nemesis completion typed {val!r} — nemesis ops must "
+                "stay :info (PassThrough client) or history.complete "
+                "rejects the history"))
+
+    return [f for f in raw if not suppressed(lines, f.line, f.rule)]
+
+
+def lint_files(paths) -> List[Finding]:
+    out: List[Finding] = []
+    for p in paths:
+        out += lint_file(p)
+    return out

@@ -1298,7 +1298,12 @@ def check_device_keys_sharded(mesh, succ, inv_proc, inv_tr, ok_proc,
     base = check_device_keys if engine == "keys" else check_device_flat
     fn = functools.partial(base, B=B // D, F=F, P=P, n_states=n_states,
                            n_transitions=n_transitions)
-    sm = jax.shard_map(
+    if hasattr(jax, "shard_map"):                    # jax >= 0.6
+        shard_map, check_kw = jax.shard_map, {"check_vma": False}
+    else:                                            # 0.4.x spelling
+        from jax.experimental.shard_map import shard_map
+        check_kw = {"check_rep": False}
+    sm = shard_map(
         lambda s, ip, it, op, dp: fn(s, ip, it, op, dp),
         mesh=mesh,
         in_specs=(PS(), PS(None, batch_axis, None),
@@ -1309,7 +1314,7 @@ def check_device_keys_sharded(mesh, succ, inv_proc, inv_tr, ok_proc,
         # closed computation, so the varying-axis bookkeeping check
         # (which trips on scan carries initialized from constants)
         # is unnecessary
-        check_vma=False)
+        **check_kw)
     return sm(succ, inv_proc, inv_tr, ok_proc, depth)
 
 

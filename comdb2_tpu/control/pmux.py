@@ -89,20 +89,28 @@ class PmuxClient:
     def used(self) -> Dict[str, int]:
         """All assignments, service -> port."""
         f = self._conn()
-        f.write("used\n")
-        f.flush()
         out: Dict[str, int] = {}
-        while True:
-            line = f.readline()
-            if not line:
-                # a dropped connection mid-listing must not read as
-                # "fewer services registered"
-                self.close()
-                raise OSError("pmux closed the connection mid-listing")
-            if line.strip() == ".":
-                break
-            port_s, svc = line.strip().split(" ", 1)
-            out[svc] = int(port_s)
+        # same error contract as _request: a daemon that died since
+        # the last call raises OSError here, and the stale socket must
+        # be DROPPED so the next call redials instead of failing on
+        # the dead connection forever
+        try:
+            f.write("used\n")
+            f.flush()
+            while True:
+                line = f.readline()
+                if not line:
+                    # a dropped connection mid-listing must not read
+                    # as "fewer services registered"
+                    raise OSError(
+                        "pmux closed the connection mid-listing")
+                if line.strip() == ".":
+                    break
+                port_s, svc = line.strip().split(" ", 1)
+                out[svc] = int(port_s)
+        except OSError:
+            self.close()
+            raise
         return out
 
     def hello(self) -> bool:

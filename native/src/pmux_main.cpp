@@ -31,6 +31,10 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -54,6 +58,15 @@ struct Pmux {
 };
 
 Pmux g;
+
+/* Outstanding serve() threads. main must not return while any are
+ * still running against the global Pmux state (use-after-destruction
+ * during daemon shutdown: a handler could hold g.mu while the
+ * destructors run). Detached threads register here; main drains the
+ * count before returning. */
+std::mutex g_conn_mu;
+std::condition_variable g_conn_cv;
+int g_conns = 0;
 
 void save_locked() {
     if (g.state_file.empty()) return;
@@ -217,6 +230,12 @@ void serve(int fd) {
     fclose(out);
 }
 
+void serve_tracked(int fd) {
+    serve(fd);
+    std::lock_guard<std::mutex> l(g_conn_mu);
+    if (--g_conns == 0) g_conn_cv.notify_all();
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
@@ -254,6 +273,7 @@ int main(int argc, char **argv) {
     }
     for (;;) {
         int fd = accept(srv, nullptr, nullptr);
+        int err = errno;   /* before the lock below can clobber it */
         {
             std::lock_guard<std::mutex> l(g.mu);
             if (g.stop) {
@@ -261,10 +281,36 @@ int main(int argc, char **argv) {
                 break;
             }
         }
-        if (fd < 0) continue;
+        if (fd < 0) {
+            /* EINTR/ECONNABORTED are transient; anything else (e.g.
+             * EMFILE under fd exhaustion) is persistent and a bare
+             * continue would busy-spin the CPU — back off briefly so
+             * the condition can clear */
+            if (err != EINTR && err != ECONNABORTED) {
+                errno = err;
+                perror("accept");
+                std::this_thread::sleep_for(
+                    std::chrono::milliseconds(50));
+            }
+            continue;
+        }
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-        std::thread(serve, fd).detach();
+        {
+            std::lock_guard<std::mutex> l(g_conn_mu);
+            ++g_conns;
+        }
+        std::thread(serve_tracked, fd).detach();
     }
     close(srv);
+    /* drain outstanding serve() threads before the globals are
+     * destroyed (the 'exit' handler itself is one of them); a hung
+     * client can't park shutdown forever — after the grace period the
+     * OS reclaims everything anyway, which is no worse than the old
+     * unconditional return */
+    {
+        std::unique_lock<std::mutex> l(g_conn_mu);
+        g_conn_cv.wait_for(l, std::chrono::seconds(5),
+                           [] { return g_conns == 0; });
+    }
     return 0;
 }

@@ -1717,8 +1717,12 @@ int main(int argc, char **argv) {
             for (int attempt = 0; attempt < 50; attempt++) {
                 int fd = dial("127.0.0.1", pmux_port, 500);
                 if (fd >= 0) {
-                    bool ok = write(fd, line.c_str(), line.size()) ==
-                              (ssize_t)line.size();
+                    /* dial() set SO_RCVTIMEO/SO_SNDTIMEO, so a pmux
+                     * that accepts and never replies counts as a
+                     * FAILED attempt (and retries) instead of parking
+                     * this thread and its fd forever; send_all covers
+                     * short writes and EINTR */
+                    bool ok = send_all(fd, line);
                     char buf[64];
                     ok = ok && read(fd, buf, sizeof buf) > 0 &&
                          buf[0] == '0';

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Repo smoke check: the static invariant checker plus a sanitizer-wired
+# native configure/build and a ct_pmux start/exit run under ASan
+# (docs/static_analysis.md). Exits non-zero on any violation.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+# APPEND to PYTHONPATH — overriding it drops the axon plugin (CLAUDE.md)
+export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
+
+echo "== static invariant checker =="
+python -m comdb2_tpu.analysis
+
+echo "== native configure/build with ASan =="
+if command -v cmake >/dev/null; then
+    cmake -DCT_SANITIZE=address -S native -B native/build-asan \
+        >/dev/null
+    cmake --build native/build-asan -j"$(nproc)" >/dev/null
+else
+    # containers without cmake: same flags CT_SANITIZE=address wires
+    echo "cmake not found — direct g++ ASan build of ct_pmux"
+    mkdir -p native/build-asan
+    g++ -fsanitize=address -fno-omit-frame-pointer -g -Wall -Wextra \
+        -Inative/include native/src/pmux_main.cpp \
+        -o native/build-asan/ct_pmux -lpthread
+fi
+
+echo "== ct_pmux start/exit under ASan =="
+PMUX=native/build-asan/ct_pmux
+PORT=${CT_CHECK_PMUX_PORT:-15105}
+# halt_on_error so a shutdown race fails the script, not just logs
+ASAN_OPTIONS=halt_on_error=1 "$PMUX" -p "$PORT" &
+PMUX_PID=$!
+trap 'kill "$PMUX_PID" 2>/dev/null || true' EXIT
+for _ in $(seq 50); do
+    if bash -c "true >/dev/tcp/127.0.0.1/$PORT" 2>/dev/null; then
+        break
+    fi
+    sleep 0.1
+done
+exec 3<>"/dev/tcp/127.0.0.1/$PORT"
+printf 'hello\nexit\n' >&3
+cat <&3 >/dev/null || true
+exec 3<&- 3>&-
+wait "$PMUX_PID"   # non-zero (ASan abort) fails the check
+trap - EXIT
+
+echo "OK: checker clean, ASan build clean, ct_pmux shutdown clean"

@@ -1,0 +1,21 @@
+"""Seeded violation: the same closure body inlined under two branches
+of nested ``lax.cond`` — XLA compiles the body once per branch path
+and CPU compile time explodes. Run the small tier unconditionally and
+select with ONE cond."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def search_step(frontier, use_small, escalate):
+    def small_tier(f):
+        return jnp.sort(f.reshape(-1))[:128]
+
+    def outer(f):
+        return lax.cond(escalate,
+                        lambda x: jnp.sort(x.reshape(-1))[:128],
+                        lambda x: x[:128], f)
+
+    return lax.cond(use_small,
+                    lambda x: jnp.sort(x.reshape(-1))[:128],
+                    outer, frontier)

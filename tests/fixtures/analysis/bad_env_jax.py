@@ -1,0 +1,13 @@
+"""Seeded violation: JAX env config after jax import.
+
+jax reads env vars at import; the ambient startup hook may have
+imported it already, so this assignment silently does nothing and the
+suite wedges on the tunneled TPU (ep_poll, 38 minutes)."""
+
+import os
+
+import jax
+
+os.environ["JAX_PLATFORMS"] = "cpu"          # <- jax-env-after-import
+
+assert jax.default_backend() == "cpu"

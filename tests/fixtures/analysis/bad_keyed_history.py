@@ -1,0 +1,14 @@
+"""Seeded violation: parses EDN histories and runs the checker
+without offering ``independent.wrap_keyed_history`` — EDN ``[k v]``
+values parse as plain tuples, and a bare 2-tuple reads as a cas pair,
+so keyed histories silently check the wrong model."""
+
+from comdb2_tpu.checker import analysis
+from comdb2_tpu.models.model import MODELS
+from comdb2_tpu.ops.native_loader import parse_history_fast
+
+
+def check_file(path):
+    with open(path) as fh:
+        history = parse_history_fast(fh.read())   # keyed? nobody asks
+    return analysis(MODELS["cas-register"](), history)

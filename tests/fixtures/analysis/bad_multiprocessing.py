@@ -1,0 +1,9 @@
+"""Seeded violation: a multiprocessing pool on the 1-CPU container
+(a spawn pool measured 322 s -> 566 s on the 4096x generation)."""
+
+import multiprocessing                        # <- no-multiprocessing
+
+
+def generate_all(items):
+    with multiprocessing.Pool(4) as pool:
+        return pool.map(str, items)

@@ -1,0 +1,11 @@
+"""Seeded violation: a nemesis completion typed ``ok``. Nemesis
+completions must stay ``:info`` (PassThrough client) — an ok/fail
+completion would let the nemesis affect the model, and
+``history.complete`` rejects the history."""
+
+
+class FlakyPartitioner:
+    def invoke(self, test, op):
+        if op["f"] == "start":
+            return {**op, "type": "ok", "value": "cut"}
+        return {**op, "value": "healed"}

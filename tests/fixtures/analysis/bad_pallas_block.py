@@ -1,0 +1,7 @@
+"""Seeded violation: a (7, 100) grid-step block. Blocks need last-two
+dims divisible by (8, 128) or equal to the array dims; Mosaic rejects
+anything else at compile time."""
+
+from jax.experimental import pallas as pl
+
+SPEC = pl.BlockSpec((7, 100), lambda i: (i, 0))  # <- pallas-block-shape

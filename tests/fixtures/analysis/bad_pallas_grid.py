@@ -1,0 +1,16 @@
+"""Seeded violation: a 2048-step Pallas grid. SMEM is bounded per
+grid step (~500 B/step toward the 1 MB space): a 2048-step grid fails
+Mosaic compile ("Exceeded smem capacity") even at prefetch width 4,
+while 1408 steps compile — keep the chunk at 1024."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def run(kernel, x):
+    return pl.pallas_call(
+        kernel,
+        grid=(2048,),                         # <- pallas-grid-steps
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+    )(x)

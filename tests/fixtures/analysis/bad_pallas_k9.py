@@ -1,0 +1,7 @@
+"""Seeded violation: requesting a kernel spec at K=9. The fused
+kernel caps K (invokes per segment) at 8; fault-window cluster
+histories can exceed it and must take the XLA path instead."""
+
+from comdb2_tpu.checker.pallas_seg import spec_for
+
+SPEC = spec_for(8, 32, 3, 9)                  # <- pallas-k-cap

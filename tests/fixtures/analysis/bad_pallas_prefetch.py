@@ -1,0 +1,13 @@
+"""Seeded violation: a 2048x10 scalar-prefetch stream. Scalar-prefetch
+SMEM holds ~14336 int32 (~56 KB) per kernel call; 2048x10 = 20480
+words fails — chunk long segment streams."""
+
+import numpy as np
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_stream(kernel_call):
+    seg = np.zeros((2048, 10), np.int32)   # <- pallas-prefetch-smem
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1024,), in_specs=[], out_specs=[])
+    return kernel_call(grid_spec, seg)

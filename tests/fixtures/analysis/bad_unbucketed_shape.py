@@ -1,0 +1,14 @@
+"""Seeded violation: unbucketed shapes at a jit boundary. XLA
+compiles one program per distinct input shape; per-seed shapes
+recompile per seed and can OOM LLVM — pad sizes to the declared
+buckets (pow2 pads, the fuzz bucket ladder)."""
+
+from comdb2_tpu.checker import linear_jax as LJ
+
+
+def check(packed, succ):
+    bucket = (13, 37)                  # <- jaxpr-unbucketed-shape
+    segs = LJ.make_segments(packed, s_pad=100, k_pad=8)   # <- and here
+    return LJ.check_device_seg(
+        succ, segs.inv_proc, segs.inv_tr, segs.ok_proc, segs.depth,
+        F=128, P=4, n_states=bucket[0], n_transitions=bucket[1])

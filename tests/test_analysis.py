@@ -1,0 +1,218 @@
+"""Tier-1: the repo-wide static invariant checker.
+
+Three contracts:
+
+- ``python -m comdb2_tpu.analysis`` exits 0 on the repo at HEAD — every
+  future PR passes the checker by construction;
+- each seeded violation fixture (tests/fixtures/analysis/) makes it
+  exit non-zero naming the expected rule id with a ``file:line`` anchor;
+- the budget analyzer's golden contract: every production ``spec_for``
+  tier is accepted, and the known-bad configs (2048-step grid, 2048x10
+  prefetch, non-(8,128) block, K=9) are rejected.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from comdb2_tpu import analysis
+from comdb2_tpu.analysis import jaxpr_audit, lint, pallas_budget
+
+REPO = analysis.repo_root()
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+#: fixture -> rule id it must trip (mirrors fixtures/analysis/README.md)
+FIXTURE_RULES = {
+    "bad_env_jax.py": "jax-env-after-import",
+    "bad_multiprocessing.py": "no-multiprocessing",
+    "bad_hash_dedup.py": "hash-dedup",
+    "bad_dup_cond.py": "dup-cond-closure",
+    "bad_keyed_history.py": "keyed-history-wrap",
+    "bad_nemesis_completion.py": "nemesis-info-completion",
+    "bad_pallas_grid.py": "pallas-grid-steps",
+    "bad_pallas_prefetch.py": "pallas-prefetch-smem",
+    "bad_pallas_block.py": "pallas-block-shape",
+    "bad_pallas_k9.py": "pallas-k-cap",
+    "bad_unbucketed_shape.py": "jaxpr-unbucketed-shape",
+}
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "comdb2_tpu.analysis", *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+
+
+# --- the repo itself is clean ------------------------------------------------
+
+def test_repo_scan_is_clean():
+    """The acceptance gate: the checker exits 0 on the repo at HEAD
+    (full run — lint, production budgets, jaxpr audit incl. the
+    abstract traces)."""
+    r = _run_cli()
+    assert r.returncode == 0, \
+        f"checker found violations at HEAD:\n{r.stdout}{r.stderr}"
+    assert "OK: 0 findings" in r.stdout
+
+
+# --- every seeded fixture fails with the right rule --------------------------
+
+def test_fixture_inventory_matches_readme():
+    on_disk = {f for f in os.listdir(FIXTURES) if f.endswith(".py")}
+    assert on_disk == set(FIXTURE_RULES), \
+        "fixtures/analysis/ and FIXTURE_RULES drifted apart"
+    # the acceptance floor: >= 8 fixtures across all three families
+    assert len(FIXTURE_RULES) >= 8
+
+
+@pytest.mark.parametrize("fixture,rule", sorted(FIXTURE_RULES.items()))
+def test_fixture_trips_rule(fixture, rule):
+    path = os.path.join(FIXTURES, fixture)
+    r = _run_cli(path)
+    assert r.returncode != 0, f"{fixture} passed the checker"
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith(rule + " ")), None)
+    assert line is not None, \
+        f"{fixture}: no {rule} finding in:\n{r.stdout}"
+    # file:line anchor present and parseable
+    loc = line.split(" ", 2)[1]
+    fpath, _, lineno = loc.rpartition(":")
+    assert fpath.endswith(fixture) and int(lineno) > 0
+
+
+def test_fixtures_excluded_from_repo_scan():
+    files = analysis.collect_files()
+    assert files and not any("fixtures" in f for f in files)
+
+
+# --- budget analyzer golden tests --------------------------------------------
+
+def test_budget_accepts_every_production_tier():
+    tiers = pallas_budget.production_tiers()
+    assert tiers, "no spec_for tier reachable from the bucket ladder"
+    for bucket, P, K, spec in tiers:
+        findings = pallas_budget.check_spec(
+            spec, where=f"spec_for({bucket},P={P},K={K})")
+        assert findings == [], [f.format() for f in findings]
+    assert pallas_budget.check_production() == []
+
+
+@pytest.mark.parametrize("cfg,rule", [
+    (dict(grid_steps=2048), "pallas-grid-steps"),
+    (dict(prefetch_int32=2048 * 10), "pallas-prefetch-smem"),
+    (dict(block=(8, 100)), "pallas-block-shape"),
+    (dict(block=(3, 128)), "pallas-block-shape"),
+    (dict(K=9), "pallas-k-cap"),
+    (dict(F=64), "pallas-f-cap"),
+])
+def test_budget_rejects_known_bad(cfg, rule):
+    findings = pallas_budget.check_config(**cfg)
+    assert findings and findings[0].rule == rule
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(grid_steps=1024),          # production CHUNK
+    dict(grid_steps=1408),          # measured compile bound
+    dict(prefetch_int32=1024 * 10),
+    dict(block=(8, 128)),
+    dict(block=(16, 128)),
+    dict(K=8, F=128),
+])
+def test_budget_accepts_known_good(cfg):
+    assert pallas_budget.check_config(**cfg) == []
+
+
+def test_budget_grid_steps_are_the_dim_product():
+    """Grid steps run sequentially, so the Mosaic bound applies to the
+    PRODUCT of the grid dims — a (64, 64) grid is 4096 steps and must
+    be flagged even though each dim alone is tiny."""
+    src = ("from jax.experimental import pallas as pl\n"
+           "def run(k, x):\n"
+           "    return pl.pallas_call(k, grid=(64, 64))(x)\n")
+    fs = pallas_budget.scan_file("<mem>", src)
+    assert [f.rule for f in fs] == ["pallas-grid-steps"]
+    assert pallas_budget.scan_file(
+        "<mem>", src.replace("(64, 64)", "(8, 128)")) == []
+
+
+def test_budget_table_artifact():
+    table = pallas_budget.budget_table()
+    assert table.startswith("# Pallas budget table")
+    # one row per distinct production tier (head, blank, 2 header rows)
+    n_rows = len(table.splitlines()) - 4
+    assert n_rows == len(pallas_budget.production_tiers())
+
+
+# --- jaxpr audit -------------------------------------------------------------
+
+def test_bucket_ladder_matches_fuzz_script():
+    """PRODUCTION_BUCKETS mirrors scripts/fuzz_pallas_seg.py; the
+    mirror must not drift (every fuzz `bucket = (a, b)` literal is in
+    the ladder, checked by the AST scan being clean on the script)."""
+    src = os.path.join(REPO, "scripts", "fuzz_pallas_seg.py")
+    assert jaxpr_audit.scan_file(src) == []
+    with open(src) as fh:
+        text = fh.read()
+    for ns, nt in pallas_budget.PRODUCTION_BUCKETS:
+        assert f"({ns}, {nt})" in text, \
+            f"bucket ({ns},{nt}) not exercised by the fuzz script"
+
+
+def test_bucket_closure():
+    assert jaxpr_audit.check_bucket_closure() == []
+
+
+def test_trace_entry_points_clean():
+    """Tracing the engine entry points across every declared bucket
+    finds no duplicated cond sub-jaxprs (and traces successfully —
+    a trace failure IS a finding)."""
+    findings = jaxpr_audit.trace_entry_points()
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_duplicated_cond_branches_detects():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def body(x):
+        # non-trivial (>= MIN_BRANCH_EQNS equations), duplicated
+        return jnp.sum(jnp.sin(x) * 2.0) + jnp.max(x)
+
+    def f(x):
+        # deliberately duplicated branch: the subject under test
+        return lax.cond(x[0] > 0, body, body, x)  # analysis: ignore[dup-cond-closure]
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones(8))
+    assert jaxpr_audit.duplicated_cond_branches(jaxpr)
+
+
+# --- suppression -------------------------------------------------------------
+
+def test_per_line_suppression():
+    src = ("import os\nimport jax\n"
+           "os.environ['JAX_PLATFORMS'] = 'cpu'"
+           "  # analysis: ignore[jax-env-after-import]\n")
+    assert lint.lint_file("<mem>", src) == []
+    # wrong rule id in the marker does NOT suppress
+    src_wrong = src.replace("jax-env-after-import", "hash-dedup")
+    assert [f.rule for f in lint.lint_file("<mem>", src_wrong)] == \
+        ["jax-env-after-import"]
+    # blanket marker suppresses everything on the line
+    src_blanket = src.replace("[jax-env-after-import]", "")
+    assert lint.lint_file("<mem>", src_blanket) == []
+
+
+def test_cli_json_artifact(tmp_path):
+    out = tmp_path / "findings.json"
+    table = tmp_path / "budgets.md"
+    r = _run_cli("--json", str(out), "--budget-table", str(table),
+                 os.path.join(FIXTURES, "bad_pallas_k9.py"))
+    assert r.returncode == 1
+    import json
+    data = json.loads(out.read_text())
+    assert data and data[0]["rule"] == "pallas-k-cap"
+    assert table.read_text().startswith("# Pallas budget table")

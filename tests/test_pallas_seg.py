@@ -34,7 +34,7 @@ def test_spec_gating():
     huge = PS.spec_for(128, 64, 2, 4)                # 8192-entry table
     assert huge is not None and huge.table_rows_pad == 64
     assert PS.spec_for(256, 64, 2, 4) is None        # table > 8192
-    assert PS.spec_for(2, 2, 1, 9) is None           # K > 8
+    assert PS.spec_for(2, 2, 1, 9) is None  # analysis: ignore[pallas-k-cap]
     # key budget: 15 slots x 13 bits = 8 words > 3 — rejected by the
     # word-layout loop itself (table 2*4096 = 8192 entries fits, so
     # this genuinely exercises the n_words cap, not MAX_TABLE)

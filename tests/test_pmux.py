@@ -107,6 +107,29 @@ def test_use_refuses_port_aliasing(tmp_path):
         _kill(p)
 
 
+def test_used_drops_dead_connection_and_redials(tmp_path):
+    """A daemon killed between calls must not poison the client: the
+    next used() on the stale socket raises OSError, DROPS the
+    connection (same contract as _request), and once a daemon is back
+    the following call transparently redials."""
+    (port,) = _free_ports(1)
+    state = tmp_path / "pmux.state"
+    p = _spawn_pmux(port, state)
+    c = PmuxClient(port=port)
+    try:
+        a = c.reg("sut/alpha")
+        assert c.used() == {"sut/alpha": a}
+        _kill(p)                     # daemon dies under the client
+        with pytest.raises(OSError):
+            c.used()
+        assert c._sock is None       # stale connection was dropped
+        p = _spawn_pmux(port, state)  # daemon returns with the state
+        assert c.used() == {"sut/alpha": a}   # redialed, not wedged
+    finally:
+        c.close()
+        _kill(p)
+
+
 def test_exit_actually_stops_the_daemon(tmp_path):
     (port,) = _free_ports(1)
     p = _spawn_pmux(port)
