@@ -14,8 +14,10 @@ reference under ``linearizable/jepsen/src/``) as a TPU-first framework:
   linearizability search (knossos/linear.clj as vmapped tensor ops),
   a host reference implementation, and the non-linearizability checkers
   (set / counter / queue / bank / dirty-reads / G2).
-- ``comdb2_tpu.parallel`` — device meshes, batching of independent
-  histories, sharded execution.
+- ``comdb2_tpu.service``  — the verification serving layer: the
+  batching checker-as-a-service daemon (shape-bucketed request
+  coalescing over TCP), its client, and device-mesh sharding (the
+  former ``comdb2_tpu.parallel``, kept as a shim).
 - ``comdb2_tpu.harness``  — the test runtime: generators, clients,
   workers, nemesis scheduling, the results store, web UI, killcluster
   oracle, and the CLI.

@@ -24,6 +24,12 @@ short story per rule id:
   ``info`` (PassThrough client) or ``history.complete`` rejects the
   history; an ok/fail completion would let the nemesis affect the
   model.
+- ``per-item-dispatch`` — a loop dispatching ``check_device_batch`` /
+  ``check_device`` per item is round-trip-bound: each dispatch pays
+  the ~100 ms tunnel round-trip (measured 1.5k ops/s serial vs 93k
+  streamed). Pack the items into ONE ``checker.batch.pack_batch`` /
+  ``check_batch`` call, or submit them to the ``comdb2_tpu.service``
+  verifier daemon, which coalesces callers into shared dispatches.
 """
 
 from __future__ import annotations
@@ -37,6 +43,12 @@ JAX_ENV_PREFIXES = ("JAX_", "XLA_")
 
 CHECKER_ENTRY_NAMES = {"analysis", "check_history"}
 PARSE_NAMES = {"parse_history", "parse_history_fast"}
+
+#: single-batch device entry points that are round-trip-bound when
+#: driven once per item from a host loop (``check_batch`` itself is
+#: the batching API — a loop over BUCKETS of coalesced work is
+#: legitimate, so only the per-history entries are flagged)
+PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device"}
 
 
 def _name_of(node: ast.AST) -> str:
@@ -74,7 +86,9 @@ class _ModuleInfo(ast.NodeVisitor):
         self.nemesis_bad_type: List[Tuple[int, str]] = []
         self.cond_calls: List[ast.Call] = []
         self.func_defs: Dict[str, ast.AST] = {}
+        self.loop_dispatch: List[Tuple[int, str]] = []
         self._fn_depth = 0
+        self._loop_depth = 0
 
     # -- imports -------------------------------------------------------
 
@@ -114,6 +128,19 @@ class _ModuleInfo(ast.NodeVisitor):
     visit_FunctionDef = _visit_fn
     visit_AsyncFunctionDef = _visit_fn
 
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+    visit_ListComp = _visit_loop
+    visit_SetComp = _visit_loop
+    visit_DictComp = _visit_loop
+    visit_GeneratorExp = _visit_loop
+
     # -- expressions ---------------------------------------------------
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -148,6 +175,8 @@ class _ModuleInfo(ast.NodeVisitor):
             if key and key.startswith(JAX_ENV_PREFIXES):
                 self.env_writes.append(
                     (node.lineno, key, self._fn_depth > 0))
+        if name in PER_ITEM_DISPATCH_NAMES and self._loop_depth > 0:
+            self.loop_dispatch.append((node.lineno, name))
         if name in PARSE_NAMES:
             self.parse_calls.append(node.lineno)
         if name in CHECKER_ENTRY_NAMES:
@@ -328,6 +357,19 @@ def lint_file(path: str, source: Optional[str] = None) -> List[Finding]:
             "referencing independent.wrap_keyed_history — EDN [k v] "
             "values parse as plain tuples (a bare 2-tuple is a cas "
             "pair)"))
+
+    if not in_tests:
+        # tests legitimately compare per-item vs batched results; the
+        # hazard is production paths serving traffic one dispatch per
+        # history (each pays the ~100 ms tunnel round-trip)
+        for ln, fname in info.loop_dispatch:
+            raw.append(Finding(
+                "per-item-dispatch", path, ln,
+                f"{fname} dispatched inside a loop — per-item device "
+                "calls are round-trip-bound (measured 1.5k vs 93k "
+                "ops/s); pack the items through checker.batch."
+                "pack_batch/check_batch or submit them to the "
+                "comdb2_tpu.service verifier daemon"))
 
     if "nemesis" in base:
         for ln, val in info.nemesis_bad_type:

@@ -82,6 +82,29 @@ def _empty_stream():
         np.zeros(1, np.int32))
 
 
+def _segments_of(p, s_pad: int = 0, k_pad: int = 0):
+    """``make_segments`` with a fast path: an exact stream cached on
+    the PackedHistory (the serving layer's admission pass computes one
+    per request to derive the shape bucket) is padded to the floors
+    with cheap numpy pads instead of re-running the O(total-ops) host
+    loop. Pad values match ``make_segments``' (dead segments/invokes
+    are ``-1`` procs)."""
+    segs = getattr(p, "_segments_exact", None)
+    if segs is None:
+        return LJ.make_segments(p, s_pad=s_pad or None,
+                                k_pad=k_pad or None)
+    S, K = segs.ok_proc.shape[0], segs.inv_proc.shape[1]
+    ds, dk = max(s_pad - S, 0), max(k_pad - K, 0)
+    if not ds and not dk:
+        return segs
+    return LJ.SegmentStream(
+        np.pad(segs.inv_proc, ((0, ds), (0, dk)), constant_values=-1),
+        np.pad(segs.inv_tr, ((0, ds), (0, dk))),
+        np.pad(segs.ok_proc, (0, ds), constant_values=-1),
+        np.pad(segs.seg_index, (0, ds)),
+        np.pad(segs.depth, (0, ds)))
+
+
 def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
                model: Model,
                max_states: int = 1 << 20,
@@ -155,7 +178,8 @@ class SegmentBatch:
 
 
 def segment_batch(batch: PackedBatch,
-                  streams: Optional[list] = None) -> SegmentBatch:
+                  streams: Optional[list] = None,
+                  s_pad: int = 0, k_pad: int = 0) -> SegmentBatch:
     """Compile each history's per-ok segments (union transition ids),
     padded to a common (S, K). Malformed histories (double-pending
     process) get an empty stream; ``check_batch`` reports them
@@ -163,14 +187,19 @@ def segment_batch(batch: PackedBatch,
     union-remapped (and possibly slot-renamed — a pure relabeling the
     XLA engines accept unchanged), e.g. from ``_stream_segments`` when
     the kernel path rejected the batch — reusing them skips a second
-    O(total-ops) host segment pass."""
+    O(total-ops) host segment pass. ``s_pad``/``k_pad`` are FLOORS on
+    the padded segment axes: a serving layer that buckets many batches
+    into a fixed (S, K) shape pins the compiled program once instead
+    of recompiling per batch (the actual maxima still win when they
+    exceed the floor — padding never truncates)."""
     prebuilt = streams is not None
     segss = streams if prebuilt else [
-        _empty_stream() if _malformed(p) else LJ.make_segments(p)
+        _empty_stream() if _malformed(p) else _segments_of(p)
         for p in batch.packeds]
-    S = _next_pow2(max((s.ok_proc.shape[0] for s in segss), default=1))
-    K = _next_pow2(max((s.inv_proc.shape[1] for s in segss),
-                       default=1), 2)
+    S = max(_next_pow2(max((s.ok_proc.shape[0] for s in segss),
+                           default=1)), s_pad)
+    K = max(_next_pow2(max((s.inv_proc.shape[1] for s in segss),
+                           default=1), 2), k_pad)
     ips, its, ops, idxs, deps = [], [], [], [], []
     for remap, s in zip(batch.remaps, segss):
         ds, dk = S - s.ok_proc.shape[0], K - s.inv_proc.shape[1]
@@ -194,7 +223,8 @@ def segment_batch(batch: PackedBatch,
     )
 
 
-def _stream_segments(batch: PackedBatch):
+def _stream_segments(batch: PackedBatch, s_pad: int = 0,
+                     k_pad: int = 0):
     """Per-history SegmentStreams with transition ids remapped into the
     union table (the streamed kernel shares ONE table) and process ids
     renamed to minimal reusable slots (:func:`~.linear_jax.remap_slots`
@@ -203,40 +233,71 @@ def _stream_segments(batch: PackedBatch):
     get an empty stream; ``check_batch`` reports them ``unknown``.
     Returns ``(streams, P_eff)`` with ``P_eff`` the max effective slot
     count over the batch (the spec the ONE shared kernel compiles for).
-    Cached on the batch: the pass is O(total ops) of host work, and
-    repeat checks of the same PackedBatch (capacity escalation, timed
-    bench runs) would otherwise pay it every call.
+    ``s_pad``/``k_pad`` floor each stream's padded (S, K) like
+    :func:`segment_batch`'s — bucketed serving keeps the streamed
+    kernel's chunk count shape-stable across batches.
+    Cached on the batch (keyed by the pads): the pass is O(total ops)
+    of host work, and repeat checks of the same PackedBatch (capacity
+    escalation, timed bench runs) would otherwise pay it every call.
     """
     cached = getattr(batch, "_stream_seg_cache", None)
-    if cached is not None:
-        return cached
+    if cached is not None and cached[0] == (s_pad, k_pad):
+        return cached[1]
     out = []
     p_eff = 1
     for i, p in enumerate(batch.packeds):
-        s = _empty_stream() if _malformed(p) else LJ.make_segments(p)
+        s = (_empty_stream() if _malformed(p)
+             else _segments_of(p, s_pad=s_pad, k_pad=k_pad))
         remap = np.asarray(batch.remaps[i], np.int32)
         if remap.size:
             inv_tr = np.where(s.inv_proc >= 0, remap[s.inv_tr],
                               0).astype(np.int32)
         else:  # no successful invokes anywhere: nothing to remap
             inv_tr = np.zeros_like(s.inv_tr, np.int32)
-        s2, pe = LJ.remap_slots(LJ.SegmentStream(
-            s.inv_proc, inv_tr, s.ok_proc, s.seg_index, s.depth))
+        cached_remap = (None if _malformed(p)
+                        else getattr(p, "_remap_cache", None))
+        if cached_remap is not None:
+            # slot renaming depends on (inv_proc, ok_proc) only, so an
+            # admission-time pass (bucket_for) is reusable verbatim —
+            # just pad its exact-shape proc arrays to this stream's
+            rproc, rok, pe = cached_remap
+            ds = s.ok_proc.shape[0] - rok.shape[0]
+            dk = s.inv_proc.shape[1] - rproc.shape[1]
+            s2 = LJ.SegmentStream(
+                np.pad(rproc, ((0, ds), (0, dk)), constant_values=-1),
+                inv_tr,
+                np.pad(rok, (0, ds), constant_values=-1),
+                s.seg_index, s.depth)
+        else:
+            s2, pe = LJ.remap_slots(LJ.SegmentStream(
+                s.inv_proc, inv_tr, s.ok_proc, s.seg_index, s.depth))
         p_eff = max(p_eff, pe)
         out.append(s2)
-    batch._stream_seg_cache = (out, p_eff)
+    batch._stream_seg_cache = ((s_pad, k_pad), (out, p_eff))
     return out, p_eff
 
 
 def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
                 batch_axis: str = "batch", engine: str = "auto",
-                info: Optional[dict] = None):
+                info: Optional[dict] = None, s_pad: int = 0,
+                k_pad: int = 0, n_states_pad: int = 0,
+                n_transitions_pad: int = 0, p_eff_pad: int = 0):
     """Run the batched device search (see :func:`_check_batch_impl`);
     malformed histories (double-pending process) come back ``unknown``
-    instead of poisoning the batch or diverging between engines."""
+    instead of poisoning the batch or diverging between engines.
+
+    The ``*_pad`` arguments floor the padded segment axes and the
+    declared memo-table sizes — a serving layer that buckets traffic
+    (:mod:`comdb2_tpu.service`) pins every tensor shape and field
+    width so all batches in a bucket share ONE compiled program.
+    Oversizing is sound: states/transitions are ids below the real
+    counts, ``pad_succ`` widens the table to match, and padding
+    segments are no-ops to every engine."""
     status, fail_at, n_final = _check_batch_impl(
         batch, F=F, mesh=mesh, batch_axis=batch_axis, engine=engine,
-        info=info)
+        info=info, s_pad=s_pad, k_pad=k_pad,
+        n_states_pad=n_states_pad,
+        n_transitions_pad=n_transitions_pad, p_eff_pad=p_eff_pad)
     bad = [i for i, p in enumerate(batch.packeds) if _malformed(p)]
     if bad:
         status = np.array(status, np.int32)
@@ -250,7 +311,9 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
 
 def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
                       batch_axis: str = "batch", engine: str = "auto",
-                      info: Optional[dict] = None):
+                      info: Optional[dict] = None, s_pad: int = 0,
+                      k_pad: int = 0, n_states_pad: int = 0,
+                      n_transitions_pad: int = 0, p_eff_pad: int = 0):
     """Run the batched device search; returns (status[N], fail_at[N],
     n_final[N]) NumPy arrays — fail_at in history-index terms. With
     ``mesh``, the batch axis is sharded across devices (data
@@ -270,13 +333,16 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
     info: optional dict — receives {"engine": name} for the path
     actually executed (observability; tests and bench assert on it).
     """
-    succ = LJ.pad_succ(batch.memo.succ,
-                       _next_pow2(batch.memo.succ.shape[0]),
-                       _next_pow2(batch.memo.succ.shape[1]))
+    # declared table sizes may be floored (bucketed) above the real
+    # counts: ids stay below the real counts, so widening the fields
+    # and the padded table is a pure relabeling of the key layout
+    n_states = max(batch.memo.n_states, n_states_pad)
+    n_transitions = max(batch.memo.n_transitions, n_transitions_pad)
+    succ = LJ.pad_succ(batch.memo.succ, _next_pow2(n_states),
+                       _next_pow2(n_transitions))
     P = _next_pow2(batch.P, 2)
     B = len(batch)
-    sizes = {"n_states": batch.memo.n_states,
-             "n_transitions": batch.memo.n_transitions}
+    sizes = {"n_states": n_states, "n_transitions": n_transitions}
     D = int(mesh.shape[batch_axis]) if mesh is not None else 1
     B_pad = -(-B // D) * D  # sharded engines need D | B
 
@@ -316,12 +382,21 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
     if engine == "stream":       # path rejects an already-built batch
         rs = None
         if stream_fits():
-            segs_list, P_stream = _stream_segments(batch)
+            segs_list, P_stream = _stream_segments(batch, s_pad=s_pad,
+                                                   k_pad=k_pad)
+            # flooring the slot count pins the kernel SPEC too — a
+            # serving layer bucketing by effective concurrency then
+            # compiles one kernel per bucket, not one per batch's
+            # incidental max (extra slots just sit idle)
+            P_stream = max(P_stream, p_eff_pad)
             prebuilt_streams = segs_list
             devices = (list(mesh.devices.flat)
                        if mesh is not None else None)
+            # the padded succ, not the raw memo table: the kernel's
+            # flat-table stride is the declared n_transitions, which
+            # may be floored above the real column count
             rs = PSEG.check_device_pallas_stream(
-                batch.memo.succ, segs_list, P=P_stream,
+                succ, segs_list, P=P_stream,
                 devices=devices, **sizes)
         if rs is not None:
             note("stream" if mesh is None else "stream-sharded")
@@ -360,9 +435,12 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
                     tr=batch.tr[unk], P=batch.P,
                     remaps=[batch.remaps[i] for i in unk])
                 sub_info: dict = {}
-                st2, fa2, n2 = check_batch(sub, F=F, mesh=mesh,
-                                           engine=esc_engine,
-                                           info=sub_info)
+                st2, fa2, n2 = check_batch(
+                    sub, F=F, mesh=mesh, engine=esc_engine,
+                    info=sub_info, s_pad=s_pad, k_pad=k_pad,
+                    n_states_pad=n_states_pad,
+                    n_transitions_pad=n_transitions_pad,
+                    p_eff_pad=p_eff_pad)
                 status, fail_at, n_final = merge_escalation(
                     status, fail_at, n_final, unk, st2, fa2, n2)
                 if info is not None:    # the label must not claim the
@@ -373,7 +451,8 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
         engine = pick_xla_engine()
     if engine in ("keys", "flat"):
         note(engine if mesh is None else engine + "-sharded")
-        sb = segment_batch(batch, streams=prebuilt_streams)
+        sb = segment_batch(batch, streams=prebuilt_streams,
+                           s_pad=s_pad, k_pad=k_pad)
         if mesh is not None:
             ip, it, op_, dp = _pad_batch_axis(sb, B_pad - B)
             status, fail_seg, n_final = LJ.check_device_keys_sharded(

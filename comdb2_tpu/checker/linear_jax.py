@@ -445,9 +445,11 @@ def make_segments(packed, s_pad: Optional[int] = None,
             pending.discard(p)
     S = len(segs)
     K = max((len(c) for c, _, _, _ in segs), default=1) or 1
-    k_pad = k_pad or K
-    s_pad = s_pad or S
-    assert k_pad >= K
+    # pads are FLOORS: callers bucketing many histories into one fixed
+    # shape pass the bucket's (S, K); the actual maxima still win so
+    # padding can never truncate a real segment
+    k_pad = max(k_pad or 0, K)
+    s_pad = max(s_pad or 0, S)
     inv_proc = np.full((s_pad, k_pad), -1, np.int32)
     inv_tr = np.zeros((s_pad, k_pad), np.int32)
     ok_proc = np.full(s_pad, -1, np.int32)   # -1 = padding segment

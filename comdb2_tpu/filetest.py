@@ -36,7 +36,47 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--keyed", action="store_true",
                    help="re-tag [k v] op values as keyed tuples "
                         "(independent-generator histories)")
+    p.add_argument("--service", metavar="HOST:PORT",
+                   help="submit to a running verifier daemon "
+                        "(python -m comdb2_tpu.service) instead of "
+                        "checking locally — no local JAX backend is "
+                        "touched; exits 3 on a daemon error reply "
+                        "(overload/bad-request: nothing was checked)")
     args = p.parse_args(argv)
+
+    if args.service:
+        # remote path first: the whole point is NOT to attach this
+        # process to a device (the tunnel costs ~100 ms per dispatch;
+        # the daemon coalesces many callers into one)
+        from .service.client import ServiceClient
+
+        host, _, port = args.service.rpartition(":")
+        with open(args.history) as fh:
+            text = fh.read()
+        try:
+            with ServiceClient(host or "127.0.0.1", int(port)) as c:
+                reply = c.check(text, model=args.model,
+                                keyed=args.keyed,
+                                raise_on_error=False)
+        except (OSError, ValueError) as e:
+            # unreachable daemon / bad HOST:PORT: nothing was checked
+            # — exiting 1 would record a linearizability violation
+            # that never happened
+            print(f"verifier service error: {e}", file=sys.stderr)
+            return 3
+        pprint.pprint(reply)
+        if not reply.get("ok"):
+            # overload/bad-request: the history was NEVER CHECKED —
+            # exit 1 would record a linearizability violation that
+            # didn't happen, 2 would claim the search gave up. A
+            # distinct code keeps the verdict exit contract honest.
+            return 3
+        valid = reply.get("valid")
+        if valid is True:
+            return 0
+        if valid == "unknown":
+            return 2
+        return 1
 
     if args.checker == "linear" and args.backend != "host":
         # only the device frontier search needs a JAX backend; the set

@@ -160,6 +160,30 @@ def update_symlinks(test: dict) -> None:
             pass
 
 
+def save_service_status(status: dict,
+                        store_root: str = "store") -> str:
+    """Persist a verifier-daemon status snapshot under
+    ``store/service/`` next to the test runs — the store web browser
+    (:mod:`.web`) serves the whole tree, so a long-running daemon's
+    queue/latency/bucket metrics are browsable like any other
+    artifact. Appends one JSON line per snapshot to ``status.jsonl``
+    (a run's history) and rewrites ``latest.json`` (the current
+    state); returns the latest path."""
+    import json
+
+    d = os.path.join(store_root, "service")
+    os.makedirs(d, exist_ok=True)
+    line = json.dumps(status, sort_keys=True)
+    with open(os.path.join(d, "status.jsonl"), "a") as fh:
+        fh.write(line + "\n")
+    latest = os.path.join(d, "latest.json")
+    tmp = latest + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(line + "\n")
+    os.replace(tmp, latest)
+    return latest
+
+
 _handlers: dict = {}
 
 

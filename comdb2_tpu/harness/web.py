@@ -68,7 +68,25 @@ def _index_html(store_root: str) -> str:
             f'<tr class="{cls}"><td><a href="/files/{qn}/{qt}/">{qn}</a>'
             f"</td><td>{qt}</td><td>{html.escape(str(valid))}</td>"
             f'<td><a href="/zip/{qn}/{qt}">zip</a></td></tr>')
-    body.append("</table></body></html>")
+    body.append("</table>")
+    # the verifier daemon's status artifact (store/service/ — written
+    # by `python -m comdb2_tpu.service --store`; docs/service.md)
+    svc = os.path.join(store_root, "service", "latest.json")
+    if os.path.exists(svc):
+        summary = ""
+        try:
+            import json as _json
+
+            st = _json.loads(open(svc).read())
+            summary = (f" — {st.get('completed', 0)} checked, "
+                       f"{st.get('dispatches', 0)} dispatches, "
+                       f"queue {st.get('queue_depth', 0)}")
+        except Exception:
+            pass
+        body.append(
+            '<p><a href="/files/service/">verifier service</a>'
+            f"{html.escape(summary)}</p>")
+    body.append("</body></html>")
     return "".join(body)
 
 

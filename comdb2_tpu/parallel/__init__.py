@@ -1,51 +1,20 @@
-"""Device meshes and sharded checking — the distributed execution
-surface (SURVEY §2.5 item 8).
+"""Deprecated shim — this grew into :mod:`comdb2_tpu.service`.
 
-Histories are packed on host and shipped to device once per analysis;
-independent keys/histories shard across ICI as pure data parallelism
-(each device checks whole (sub)histories — no intra-search
-communication); multi-host DCN only shards more histories.
+The mesh/sharding helpers that lived here moved verbatim to
+:mod:`comdb2_tpu.service.sharding` when the serving subsystem was
+built around them; import from there. This module re-exports them so
+existing callers keep working one release longer.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import warnings
 
-import numpy as np
+from ..service.sharding import (check_histories_sharded,  # noqa: F401
+                                make_mesh)
 
-
-def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
-    """A 1-D device mesh over the first n devices (all by default)."""
-    import jax
-    from jax.sharding import Mesh
-
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    return Mesh(np.asarray(devs), (axis,))
-
-
-def check_histories_sharded(histories, model, mesh=None, F: int = 256,
-                            axis: str = "batch"):
-    """Check many independent histories with the batch axis sharded
-    over a mesh; returns (status, fail_at, n_final) NumPy arrays.
-    Builds the mesh over all local devices when none is given."""
-    from ..checker.batch import check_batch, pack_batch
-
-    histories = list(histories)
-    n = len(histories)
-    if n == 0:
-        return (np.zeros(0, np.int32), np.zeros(0, np.int64),
-                np.zeros(0, np.int32))
-    mesh = mesh if mesh is not None else make_mesh(axis=axis)
-    # the batch axis must divide evenly across mesh devices; pad with
-    # copies of the first history and slice the results back
-    n_dev = mesh.devices.size
-    pad = (-n) % n_dev
-    batch = pack_batch(histories + [histories[0]] * pad, model)
-    status, fail_at, n_final = check_batch(batch, F=F, mesh=mesh,
-                                           batch_axis=axis)
-    return status[:n], fail_at[:n], n_final[:n]
-
+warnings.warn(
+    "comdb2_tpu.parallel moved to comdb2_tpu.service.sharding; "
+    "import from there", DeprecationWarning, stacklevel=2)
 
 __all__ = ["make_mesh", "check_histories_sharded"]

@@ -1,0 +1,27 @@
+"""``comdb2_tpu.service`` — the verification serving layer.
+
+The checker's hot path amortizes only when many histories ride one
+device dispatch (~100 ms tunnel round-trip per dispatch; 1.5k ops/s
+per-item vs 93k streamed — CLAUDE.md), but every caller used to drive
+it one history at a time. This package is the layer that exploits the
+batch entry points (:mod:`comdb2_tpu.checker.batch`) as a persistent
+daemon:
+
+- :mod:`.protocol`   — newline-JSON framing over TCP.
+- :mod:`.bucketing`  — shape quantization: a small closed set of
+  compiled programs no matter what traffic arrives.
+- :mod:`.core`       — admission queue, coalescing dispatcher,
+  backpressure/deadlines, host-engine degradation, metrics.
+- :mod:`.daemon`     — the selector loop; ``python -m
+  comdb2_tpu.service`` runs it (pmux discovery, store artifacts).
+- :mod:`.client`     — retrying client; ``filetest --service`` uses
+  it.
+- :mod:`.sharding`   — device meshes + sharded batch checking (the
+  former ``comdb2_tpu.parallel``).
+"""
+
+from .bucketing import Bucket, ServiceLimits, bucket_for     # noqa: F401
+from .core import DEFAULT_PRIME, VerifierCore                # noqa: F401
+
+__all__ = ["Bucket", "DEFAULT_PRIME", "ServiceLimits",
+           "VerifierCore", "bucket_for"]

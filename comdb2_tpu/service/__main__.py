@@ -1,0 +1,128 @@
+"""CLI: ``python -m comdb2_tpu.service`` — run the verifier daemon.
+
+Prints one JSON ready-line (``{"ready": true, "port": N, ...}``) on
+stdout once listening; scripts parse it instead of racing the port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+from typing import List, Optional
+
+from .bucketing import ServiceLimits
+from .core import DEFAULT_PRIME, VerifierCore
+from .daemon import PMUX_SERVICE, VerifierDaemon
+
+
+def _force_backend(name: str) -> str:
+    """Pick the JAX platform through the config API — env vars are
+    read at import and the ambient startup hook may have imported jax
+    already (CLAUDE.md); also turn on the persistent compile cache so
+    a restarted daemon reuses every bucket's programs."""
+    import jax
+
+    from ..utils.platform import enable_compile_cache, ensure_backend
+
+    enable_compile_cache()
+    if name == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        backend = jax.default_backend()
+        if backend != "cpu":
+            raise SystemExit(
+                f"requested cpu but got {backend!r} — a backend was "
+                "initialized before the daemon could switch platforms")
+        return backend
+    # "auto"/"tpu": keep the ambient platform (the tunneled TPU
+    # registers under the plugin's own name, e.g. "axon" — forcing the
+    # literal string "tpu" would crash with "unknown backend")
+    backend = ensure_backend()
+    if name == "tpu" and backend == "cpu":
+        raise SystemExit("requested a TPU backend but only cpu is "
+                         "available in this environment")
+    return backend
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m comdb2_tpu.service",
+        description="batching checker-as-a-service daemon")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = pick a free port (printed in the "
+                        "ready line)")
+    p.add_argument("--model", default="cas-register")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "stream", "keys", "flat", "vmap"])
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "cpu", "tpu"],
+                   help="JAX platform (config API, not env)")
+    p.add_argument("--frontier", type=int, default=1024,
+                   help="device frontier capacity F")
+    p.add_argument("--batch-cap", type=int, default=64,
+                   help="max live requests per device dispatch")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission cap; beyond it requests get "
+                        "explicit overload replies")
+    p.add_argument("--coalesce-ms", type=float, default=5.0,
+                   help="how long a queued request may wait for "
+                        "batch-mates before a tick fires")
+    p.add_argument("--max-ops", type=int, default=8192)
+    p.add_argument("--max-segments", type=int, default=4096)
+    p.add_argument("--no-prime", action="store_true",
+                   help="skip compile-cache warm-start at boot")
+    p.add_argument("--interpret", action="store_true",
+                   help="run the fused Pallas kernel in interpret "
+                        "mode (exact kernel semantics as XLA ops on "
+                        "any backend; per-spec compiles are slow)")
+    p.add_argument("--inject-dispatch-latency-ms", type=float,
+                   default=0.0, metavar="MS",
+                   help="benchmarking: sleep MS per device dispatch, "
+                        "modeling the tunneled TPU's ~100 ms "
+                        "dispatch+readback round-trip on CPU; "
+                        "reported in status as injected")
+    p.add_argument("--pmux", type=int, nargs="?", const=5105,
+                   default=None, metavar="PORT",
+                   help="publish the port under sut/verifier via "
+                        "ct_pmux at PORT (default 5105)")
+    p.add_argument("--pmux-service", default=PMUX_SERVICE)
+    p.add_argument("--store", default=None, metavar="DIR",
+                   help="persist status snapshots under DIR/service/ "
+                        "(served by the store web browser)")
+    args = p.parse_args(argv)
+
+    backend = _force_backend(args.backend)
+    if args.interpret:
+        from ..checker import pallas_seg
+
+        pallas_seg.use_interpret(True)
+    limits = ServiceLimits(max_ops=args.max_ops,
+                           max_segments=args.max_segments)
+    core = VerifierCore(
+        model=args.model, engine=args.engine,
+        F=args.frontier, batch_cap=args.batch_cap,
+        max_queue=args.max_queue, limits=limits,
+        inject_dispatch_latency_s=args.inject_dispatch_latency_ms
+        / 1e3)
+    daemon = VerifierDaemon(core, host=args.host, port=args.port,
+                            coalesce_s=args.coalesce_ms / 1e3,
+                            pmux_port=args.pmux,
+                            pmux_service=args.pmux_service,
+                            store_root=args.store)
+    signal.signal(signal.SIGTERM, daemon.stop)
+    signal.signal(signal.SIGINT, daemon.stop)
+    primed = 0
+    if not args.no_prime:
+        primed = core.prime(DEFAULT_PRIME)
+    print(json.dumps({"ready": True, "host": daemon.host,
+                      "port": daemon.port, "backend": backend,
+                      "model": args.model, "primed": primed}),
+          flush=True)
+    daemon.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Shape bucketing for the verifier service.
+
+XLA compiles one program per distinct input shape, and on this
+hardware a cold compile costs seconds-to-minutes while a cached
+dispatch costs microseconds — so the daemon must see a SMALL, CLOSED
+set of shapes no matter what traffic arrives. Every admitted history
+is quantized onto a bucket whose axes mirror what actually reaches
+the jit boundaries in :func:`comdb2_tpu.checker.batch.check_batch`:
+
+- ``n_pad``  — the op-stream pad (pow2, floor 16): the vmap engine's
+  scan length.
+- ``S``      — padded segment count (pow2, floor 8): the keys/flat
+  engines' scan length and the streamed kernel's chunk budget.
+- ``K``      — padded invokes-per-segment (pow2, floor 2).
+- ``P``      — the slot-tensor width the engines compile for. This is
+  the pow2 of the PROCESS-table size (what ``check_batch`` derives
+  its ``P`` from), not the renamed-slot count — two histories with
+  equal concurrency but different process counts would otherwise
+  compile two programs.
+
+The dispatcher additionally floors the memoized table sizes
+(``n_states``/``n_transitions``) to pow2 per batch, so the packed key
+field widths — the last shape-like input — are bucketed too.
+
+Histories the bucket table can't serve cheaply (too long, too many
+segments, invoke bursts past the kernel's K cap, concurrency past the
+slot budget) are routed to the HOST engine instead of poisoning a
+batch: one slow request degrades alone, exactly like the reference
+wrapping per-key checker blowups in ``check-safe``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+from ..ops.packed import PackedHistory
+from ..utils import next_pow2 as _next_pow2
+
+
+@dataclass(frozen=True)
+class ServiceLimits:
+    """Admission limits — anything beyond them degrades to the host
+    engine (bounded there by ``max_host_configs``, so a pathological
+    history answers ``unknown`` rather than wedging the tick loop)."""
+
+    max_ops: int = 8192          # raw history rows
+    max_segments: int = 4096     # ok-op segments (chunked-engine line)
+    max_invokes_per_seg: int = 8  # the fused kernel's K cap
+    max_slots: int = 16          # effective concurrency (P_eff)
+    max_processes: int = 32      # raw process-table width
+
+
+class Bucket(NamedTuple):
+    """One compiled-shape class; ``key`` names it in metrics/replies.
+    ``P`` pins the XLA engines' slot width (process-table pow2);
+    ``P_eff`` pins the fused stream kernel's renamed-slot spec — both
+    must be in the bucket or the respective path recompiles per
+    batch."""
+
+    n_pad: int
+    S: int
+    K: int
+    P: int
+    P_eff: int
+
+    @property
+    def key(self) -> str:
+        return (f"n{self.n_pad}-s{self.S}-k{self.K}-p{self.P}"
+                f"-e{self.P_eff}")
+
+
+def bucket_for(packed: PackedHistory,
+               limits: ServiceLimits) -> Optional[Bucket]:
+    """The bucket a packed history lands in, or None when it exceeds
+    the limits (host-engine route). Raises ``ValueError`` on malformed
+    histories (double-pending process — ``make_segments``' contract);
+    the admission path answers those ``unknown``.
+
+    The exact segment stream computed here is cached on ``packed``
+    (``_segments_exact``) — the dispatch path's segment builders pad
+    it to the bucket floors instead of re-running the O(total-ops)
+    host pass (this container has ONE CPU; the pass would otherwise
+    run twice per request)."""
+    from ..checker import linear_jax as LJ
+
+    segs = LJ.make_segments(packed)
+    renamed, p_eff = LJ.remap_slots(segs)
+    try:
+        packed._segments_exact = segs
+        # the slot renaming is determined by (inv_proc, ok_proc) alone
+        # — identical whether it runs before or after transition-id
+        # union remapping — so the dispatch path reuses these proc
+        # arrays instead of re-running the O(ops) pass per request
+        packed._remap_cache = (renamed.inv_proc, renamed.ok_proc,
+                               p_eff)
+    except AttributeError:
+        pass                     # slotted/frozen variants: recompute
+    S = segs.ok_proc.shape[0]
+    K = segs.inv_proc.shape[1]
+    n_procs = len(packed.process_table)
+    if (len(packed) > limits.max_ops or S > limits.max_segments
+            or K > limits.max_invokes_per_seg
+            or p_eff > limits.max_slots
+            or n_procs > limits.max_processes):
+        return None
+    # effective slots: even-bucket while that stays in the kernel's
+    # (8,128) tier; past it use the exact count — a pad slot there can
+    # cost a whole extra key word (same rule as the driver's P_k in
+    # checker/linear.py)
+    pe = max(p_eff + (p_eff & 1), 2)
+    if pe > 7:
+        pe = max(p_eff, 2)
+    return Bucket(n_pad=_next_pow2(len(packed), 16),
+                  S=_next_pow2(S, 8),
+                  K=_next_pow2(K, 2),
+                  P=_next_pow2(max(n_procs, 2), 2),
+                  P_eff=pe)
+
+
+__all__ = ["Bucket", "ServiceLimits", "bucket_for"]

@@ -1,0 +1,152 @@
+"""Client for the verifier daemon — retry/backoff over the newline-
+JSON protocol.
+
+``check`` is pure verification (no side effects on the daemon beyond
+metrics), so a lost connection retries the SAME request safely — the
+cdb2api HA-retry shape without needing replay nonces. Only an
+exhausted retry budget surfaces to the caller.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import List, Optional, Union
+
+from . import protocol
+from .daemon import PMUX_SERVICE
+
+
+class ServiceError(Exception):
+    """The daemon answered ``ok: false`` (``.code`` holds the error
+    code, e.g. ``"overload"``)."""
+
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(f"{code}: {message}" if message else code)
+        self.code = code
+
+
+class ServiceClient:
+    """One connection to the daemon, redialed on failure."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5107,
+                 timeout_s: float = 120.0, retries: int = 3,
+                 backoff_s: float = 0.05):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._seq = 0
+
+    @classmethod
+    def discover(cls, pmux_port: int = 5105,
+                 service: str = PMUX_SERVICE, host: str = "127.0.0.1",
+                 **kw) -> "ServiceClient":
+        """Resolve the daemon's port through pmux (the port-less
+        discovery path the native SUT clients use)."""
+        from ..control.pmux import PmuxClient
+
+        with PmuxClient(host, pmux_port) as c:
+            port = c.get(service)
+        if port is None:
+            raise OSError(f"pmux does not know {service!r}")
+        return cls(host, port, **kw)
+
+    # -- plumbing ------------------------------------------------------
+
+    def _conn(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            self._file = self._sock.makefile("rb")
+        return self._sock, self._file
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._file = None
+
+    def _request(self, obj: dict) -> dict:
+        """Send one request, await its reply; redial + retry with
+        backoff on connection failure (checks are idempotent)."""
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            try:
+                sock, f = self._conn()
+                sock.sendall(protocol.encode(obj))
+                line = f.readline()
+                if not line.endswith(b"\n"):
+                    # truncated = daemon died mid-reply; same contract
+                    # as the SUT client's partial-reply rejection
+                    raise OSError("truncated reply")
+                return protocol.decode(line)
+            except (OSError, ValueError) as e:
+                last = e
+                self.close()
+        raise OSError(f"verifier at {self.host}:{self.port} "
+                      f"unreachable after {self.retries + 1} "
+                      f"attempts: {last}")
+
+    # -- API -----------------------------------------------------------
+
+    def check(self, history: Union[str, List, None] = None, *,
+              model: Optional[str] = None, keyed: bool = False,
+              deadline_ms: Optional[int] = None,
+              raise_on_error: bool = True) -> dict:
+        """Verify one history. ``history`` is EDN text or a list of
+        ``Op``s (serialized via ``history_to_edn``). Returns the reply
+        dict (``valid`` is the tri-state); daemon-side errors raise
+        :class:`ServiceError` unless ``raise_on_error=False``."""
+        if not isinstance(history, str):
+            from ..ops.history import history_to_edn
+
+            history = history_to_edn(list(history or []))
+        self._seq += 1
+        req: dict = {"op": "check", "id": self._seq,
+                     "history": history}
+        if model is not None:
+            req["model"] = model
+        if keyed:
+            req["keyed"] = True
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+        reply = self._request(req)
+        if raise_on_error and not reply.get("ok"):
+            raise ServiceError(reply.get("error", "unknown-error"),
+                               reply.get("message", ""))
+        return reply
+
+    def status(self) -> dict:
+        return self._request({"op": "status"})
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._request({"op": "ping"}).get("pong"))
+        except OSError:
+            return False
+
+    def shutdown(self) -> bool:
+        try:
+            return bool(self._request({"op": "shutdown"}).get("bye"))
+        except OSError:
+            return False
+
+    def __enter__(self) -> "ServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = ["ServiceClient", "ServiceError"]

@@ -1,0 +1,299 @@
+"""The verifier daemon — a single-threaded selector loop over
+:class:`~.core.VerifierCore`.
+
+Coalescing policy: requests queue while the loop keeps seeing new
+bytes; a tick fires when (a) the oldest queued request has waited the
+coalesce window, (b) the queue reached the batch cap, or (c) a select
+round went idle (so a lone serial caller is answered immediately
+instead of always paying the window). Device dispatches run inline on
+this same thread — the container has ONE CPU, and the whole point is
+one dispatch per tick, so there is nothing to overlap with.
+
+Discovery: with ``--pmux``, the daemon publishes its port under
+``sut/verifier`` through the same ``ct_pmux`` path the native SUT
+uses (``control/pmux.py``); clients then resolve the service by name.
+
+Observability: ``{"op": "status"}`` returns the metrics JSON on the
+same socket; with ``--store`` the same snapshot is persisted through
+:func:`comdb2_tpu.harness.store.save_service_status` on every
+artifact interval and at shutdown, where the store web browser serves
+it next to test runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import selectors
+import socket
+import time
+from typing import Dict, Optional
+
+from . import protocol
+from .core import VerifierCore
+
+logger = logging.getLogger(__name__)
+
+PMUX_SERVICE = "sut/verifier"
+
+
+class _Conn:
+    __slots__ = ("sock", "addr", "rbuf")
+
+    def __init__(self, sock, addr):
+        self.sock = sock
+        self.addr = addr
+        self.rbuf = b""
+
+
+class VerifierDaemon:
+    """One listening socket, N client connections, one tick loop."""
+
+    def __init__(self, core: VerifierCore, host: str = "127.0.0.1",
+                 port: int = 0, coalesce_s: float = 0.005,
+                 pmux_port: Optional[int] = None,
+                 pmux_service: str = PMUX_SERVICE,
+                 store_root: Optional[str] = None,
+                 artifact_interval_s: float = 30.0):
+        self.core = core
+        self.coalesce_s = coalesce_s
+        self.pmux_port = pmux_port
+        self.pmux_service = pmux_service
+        self.store_root = store_root
+        self.artifact_interval_s = artifact_interval_s
+        self._stop = False
+        self._dropped_replies = 0
+        self._sel = selectors.DefaultSelector()
+        self._conns: Dict[int, _Conn] = {}
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((host, port))
+        lsock.listen(128)
+        lsock.setblocking(False)
+        self._lsock = lsock
+        self.host, self.port = lsock.getsockname()
+        self._sel.register(lsock, selectors.EVENT_READ, None)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def stop(self, *_args) -> None:
+        self._stop = True
+
+    def run(self) -> None:
+        self._pmux_publish()
+        last_artifact = time.monotonic()
+        try:
+            while not self._stop:
+                timeout = self._select_timeout()
+                got_bytes = self._pump(timeout)
+                now = time.monotonic()
+                if self._should_tick(now, got_bytes):
+                    for p, reply in self.core.tick(now):
+                        self._send(p.ctx, reply)
+                if self.store_root is not None and \
+                        now - last_artifact >= self.artifact_interval_s:
+                    self._save_artifact()
+                    last_artifact = now
+        finally:
+            self._shutdown()
+
+    #: with work queued, select() sleeps at most this long — an empty
+    #: probe round means traffic went quiet, and the idle flush fires
+    #: the tick instead of making a lone serial caller wait out the
+    #: whole coalesce window
+    IDLE_PROBE_S = 0.001
+
+    def _select_timeout(self) -> Optional[float]:
+        if self.core.queue:
+            oldest = self.core.queue[0].t_in
+            remaining = max(0.0, oldest + self.coalesce_s
+                            - time.monotonic())
+            return min(remaining, self.IDLE_PROBE_S)
+        return 0.5
+
+    def _should_tick(self, now: float, got_bytes: bool) -> bool:
+        q = self.core.queue
+        if not q:
+            return False
+        return (len(q) >= self.core.batch_cap
+                or now - q[0].t_in >= self.coalesce_s
+                or not got_bytes)        # idle flush: serial callers
+        # never wait out the window when no more traffic is arriving
+
+    # -- socket plumbing -----------------------------------------------
+
+    def _pump(self, timeout: Optional[float]) -> bool:
+        """One select round; returns whether any payload arrived."""
+        got = False
+        for key, _ in self._sel.select(timeout):
+            if key.data is None:
+                self._accept()
+                continue
+            got |= self._read(key.data)
+        return got
+
+    def _accept(self) -> None:
+        try:
+            sock, addr = self._lsock.accept()
+        except OSError:
+            return
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock, addr)
+        self._conns[sock.fileno()] = conn
+        self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _read(self, conn: _Conn) -> bool:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            self._close(conn)
+            return False
+        if not data:
+            self._close(conn)
+            return False
+        conn.rbuf += data
+        while b"\n" in conn.rbuf:
+            line, conn.rbuf = conn.rbuf.split(b"\n", 1)
+            if line.strip():
+                self._handle(conn, line)
+        return True
+
+    def _close(self, conn: _Conn) -> None:
+        """A client vanished — mid-request is fine: its pending reply
+        is dropped at send time, the batch runs regardless."""
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        self._conns.pop(conn.sock.fileno(), None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    #: per-reply send bound: client sockets are non-blocking for the
+    #: selector reads, and sendall() on a non-blocking socket raises
+    #: BlockingIOError the moment the kernel buffer fills (a pipelined
+    #: client slow to read) — a live client's replies would be dropped
+    #: mid-stream. A temporary timeout makes the send blocking-with-
+    #: bound instead; a client that can't drain a small reply within
+    #: it is treated as gone.
+    SEND_TIMEOUT_S = 5.0
+
+    def _send(self, conn: Optional[_Conn], obj: dict) -> None:
+        if conn is None or conn.sock.fileno() < 0:
+            self._dropped_replies += 1
+            return
+        try:
+            conn.sock.settimeout(self.SEND_TIMEOUT_S)
+            try:
+                conn.sock.sendall(protocol.encode(obj))
+            finally:
+                conn.sock.settimeout(0)     # back to non-blocking
+        except OSError:
+            self._dropped_replies += 1
+            self._close(conn)
+
+    # -- requests ------------------------------------------------------
+
+    def _handle(self, conn: _Conn, line: bytes) -> None:
+        try:
+            req = protocol.decode(line)
+        except ValueError as e:
+            self._send(conn, protocol.error_reply(
+                protocol.BAD_REQUEST, str(e)))
+            return
+        op = req.get("op")
+        now = time.monotonic()
+        if op == "check":
+            try:
+                pending, reply = self.core.submit(req, now, ctx=conn)
+            except Exception as e:          # noqa: BLE001 — client data
+                # belt-and-braces: a request that slips past submit's
+                # validation must never tear down the shared daemon
+                reply = protocol.error_reply(
+                    protocol.BAD_REQUEST,
+                    f"{type(e).__name__}: {e}", req.get("id"))
+            if reply is not None:
+                self._send(conn, reply)
+            return
+        rid = req.get("id")
+        if op == "status":
+            st = self.core.status(now)
+            st["dropped_replies"] = self._dropped_replies
+            st["connections"] = len(self._conns)
+            out = {"ok": True, "status": st}
+            if rid is not None:
+                out["id"] = rid
+            self._send(conn, out)
+        elif op == "ping":
+            self._send(conn, {"ok": True, "pong": True,
+                              **({"id": rid} if rid is not None
+                                 else {})})
+        elif op == "shutdown":
+            self._send(conn, {"ok": True, "bye": True,
+                              **({"id": rid} if rid is not None
+                                 else {})})
+            self._stop = True
+        else:
+            self._send(conn, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unknown op {op!r}", rid))
+
+    # -- discovery / artifacts -----------------------------------------
+
+    def _pmux_publish(self) -> None:
+        if self.pmux_port is None:
+            return
+        from ..control.pmux import PmuxClient
+
+        try:
+            with PmuxClient(port=self.pmux_port) as c:
+                c.use(self.pmux_service, self.port)
+            logger.info("published %s -> %d via pmux:%d",
+                        self.pmux_service, self.port, self.pmux_port)
+        except OSError as e:
+            # discovery is additive; a dead pmux must not stop serving
+            logger.warning("pmux registration failed: %s", e)
+
+    def _pmux_withdraw(self) -> None:
+        if self.pmux_port is None:
+            return
+        from ..control.pmux import PmuxClient
+
+        try:
+            with PmuxClient(port=self.pmux_port) as c:
+                c.delete(self.pmux_service)
+        except OSError:
+            pass
+
+    def _save_artifact(self) -> None:
+        from ..harness.store import save_service_status
+
+        try:
+            save_service_status(self.core.status(),
+                                store_root=self.store_root)
+        except OSError as e:
+            logger.warning("service artifact write failed: %s", e)
+
+    def _shutdown(self) -> None:
+        """Answer nothing new, flush queued requests as unknown, close
+        every socket — a clean exit, never a hang with clients blocked
+        on reads."""
+        for p, reply in self.core.tick(time.monotonic()):
+            self._send(p.ctx, reply)
+        for conn in list(self._conns.values()):
+            self._close(conn)
+        try:
+            self._sel.unregister(self._lsock)
+        except (KeyError, ValueError):
+            pass
+        self._lsock.close()
+        self._sel.close()
+        self._pmux_withdraw()
+        if self.store_root is not None:
+            self._save_artifact()
+
+
+__all__ = ["PMUX_SERVICE", "VerifierDaemon"]

@@ -1,0 +1,55 @@
+"""Device meshes and sharded checking — the distributed execution
+surface of the service layer (moved here from the former
+``comdb2_tpu.parallel`` stub when the serving subsystem grew around
+it; that name remains as a deprecation shim).
+
+Histories are packed on host and shipped to device once per analysis;
+independent keys/histories shard across ICI as pure data parallelism
+(each device checks whole (sub)histories — no intra-search
+communication); multi-host DCN only shards more histories. The
+verifier daemon (:mod:`.daemon`) can hand a mesh-backed
+``check_batch`` the same bucketed batches it builds for one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
+    """A 1-D device mesh over the first n devices (all by default)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def check_histories_sharded(histories, model, mesh=None, F: int = 256,
+                            axis: str = "batch"):
+    """Check many independent histories with the batch axis sharded
+    over a mesh; returns (status, fail_at, n_final) NumPy arrays.
+    Builds the mesh over all local devices when none is given."""
+    from ..checker.batch import check_batch, pack_batch
+
+    histories = list(histories)
+    n = len(histories)
+    if n == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int64),
+                np.zeros(0, np.int32))
+    mesh = mesh if mesh is not None else make_mesh(axis=axis)
+    # the batch axis must divide evenly across mesh devices; pad with
+    # copies of the first history and slice the results back
+    n_dev = mesh.devices.size
+    pad = (-n) % n_dev
+    batch = pack_batch(histories + [histories[0]] * pad, model)
+    status, fail_at, n_final = check_batch(batch, F=F, mesh=mesh,
+                                           batch_axis=axis)
+    return status[:n], fail_at[:n], n_final[:n]
+
+
+__all__ = ["make_mesh", "check_histories_sharded"]

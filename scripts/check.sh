@@ -46,4 +46,60 @@ exec 3<&- 3>&-
 wait "$PMUX_PID"   # non-zero (ASan abort) fails the check
 trap - EXIT
 
-echo "OK: checker clean, ASan build clean, ct_pmux shutdown clean"
+echo "== verifier service smoke (CPU backend) =="
+# zombie baseline BEFORE the daemon runs: the post-shutdown check
+# below must catch NEW zombies (a reaped child can't show Z, so the
+# meaningful assertion is "no more Z states than before, and no
+# surviving service process")
+ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+SVC_LOG=$(mktemp)
+JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
+    --backend cpu --no-prime --frontier 64 >"$SVC_LOG" 2>&1 &
+SVC_PID=$!
+trap 'kill "$SVC_PID" 2>/dev/null || true' EXIT
+for _ in $(seq 200); do     # the ready line carries the chosen port
+    grep -q '"ready"' "$SVC_LOG" 2>/dev/null && break
+    sleep 0.1
+done
+grep -q '"ready"' "$SVC_LOG" || { echo "daemon never became ready"; \
+    cat "$SVC_LOG"; exit 1; }
+SVC_LOG="$SVC_LOG" python - <<'EOF'
+import json, os
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.service.client import ServiceClient
+
+# the log merges stdout+stderr, and jax/absl init noise may precede
+# the ready line — scan for it instead of assuming line 1
+port = None
+with open(os.environ["SVC_LOG"]) as fh:
+    for line in fh:
+        if '"ready"' in line:
+            port = json.loads(line)["port"]
+            break
+assert port is not None, "no ready line in daemon log"
+c = ServiceClient("127.0.0.1", port, timeout_s=300.0, retries=5,
+                  backoff_s=0.5)
+h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+     O.invoke(1, "read", None), O.Op(1, "ok", "read", 1)]
+r = c.check(h)
+assert r.get("ok") and r.get("valid") is True, r
+st = c.status()["status"]
+assert st["completed"] >= 1 and st["dispatches"] >= 1, st
+assert c.shutdown()
+EOF
+wait "$SVC_PID"            # clean exit 0, and the wait reaps it
+trap - EXIT
+# the daemon itself is reaped by the wait above — what must NOT
+# remain is any surviving service process or a NEW zombie it left
+# behind (ps -o stat= per CLAUDE.md: pkill'd daemons linger as Z)
+if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
+    echo "verifier daemon left a process behind"; exit 1
+fi
+ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
+if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+    echo "verifier daemon left a zombie" \
+         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)"; exit 1
+fi
+
+echo "OK: checker clean, ASan build clean, ct_pmux shutdown clean," \
+     "verifier service shutdown clean"

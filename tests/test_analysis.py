@@ -32,6 +32,7 @@ FIXTURE_RULES = {
     "bad_dup_cond.py": "dup-cond-closure",
     "bad_keyed_history.py": "keyed-history-wrap",
     "bad_nemesis_completion.py": "nemesis-info-completion",
+    "bad_dispatch_loop.py": "per-item-dispatch",
     "bad_pallas_grid.py": "pallas-grid-steps",
     "bad_pallas_prefetch.py": "pallas-prefetch-smem",
     "bad_pallas_block.py": "pallas-block-shape",

@@ -1,9 +1,9 @@
-"""Chunked device execution + progress callbacks, the parallel module,
-and repl helpers."""
+"""Chunked device execution + progress callbacks, the sharding module
+(formerly ``comdb2_tpu.parallel``), and repl helpers."""
 
 import random
 
-from comdb2_tpu import parallel
+from comdb2_tpu.service import sharding as parallel
 from comdb2_tpu.checker import analysis
 from comdb2_tpu.models import model as M
 from comdb2_tpu.ops.synth import register_history, mutate
