@@ -47,8 +47,12 @@ PARSE_NAMES = {"parse_history", "parse_history_fast"}
 #: single-batch device entry points that are round-trip-bound when
 #: driven once per item from a host loop (``check_batch`` itself is
 #: the batching API — a loop over BUCKETS of coalesced work is
-#: legitimate, so only the per-history entries are flagged)
-PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device"}
+#: legitimate, so only the per-history entries are flagged). The txn
+#: closure engine's entries are covered too: one cycle check per
+#: dependency graph must ride ``closure_diag_batch`` (or the service
+#: txn kind), never a loop of ``closure_diag`` calls.
+PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device",
+                           "closure_diag", "cyclic_layers_device"}
 
 
 def _name_of(node: ast.AST) -> str:

@@ -13,11 +13,13 @@
 
 from .linear import analysis, Analysis
 from .checkers import (Checker, check_safe, compose, merge_valid,
-                       linearizable, Linearizable, unbridled_optimism,
+                       linearizable, Linearizable, serializable,
+                       Serializable, unbridled_optimism,
                        queue, set_checker, total_queue, counter)
 from . import independent, workloads, wgl
 
 __all__ = ["analysis", "Analysis", "Checker", "check_safe", "compose",
            "merge_valid", "linearizable", "Linearizable",
+           "serializable", "Serializable",
            "unbridled_optimism", "queue", "set_checker", "total_queue",
            "counter", "independent", "workloads", "wgl"]

@@ -34,10 +34,16 @@ _VALID_PRIORITY = {True: 0, UNKNOWN: 0.5, False: 1}
 
 
 def merge_valid(valids: Sequence[Any]):
-    """The highest-priority verdict wins (``checker.clj:27-35``)."""
+    """The highest-priority verdict wins (``checker.clj:27-35``).
+    A verdict value outside the tri-state (a buggy sub-checker
+    returning ``"crashed"``, a None) coerces to ``unknown`` — it must
+    neither silently win as a pseudo-False nor leak a non-tri-state
+    value to callers switching on the result."""
     out = True
     for v in valids:
-        if _VALID_PRIORITY.get(v, 1) > _VALID_PRIORITY.get(out, 1):
+        if v not in _VALID_PRIORITY:
+            v = UNKNOWN
+        if _VALID_PRIORITY[v] > _VALID_PRIORITY[out]:
             out = v
     return out
 
@@ -139,6 +145,73 @@ class Linearizable(Checker):
 
 
 linearizable = Linearizable()
+
+
+class Serializable(Checker):
+    """Transactional serializability via the dependency-graph checker
+    (:mod:`comdb2_tpu.txn`): Elle-style edge inference over
+    list-append txn ops, then cycle detection — host Tarjan or the
+    TPU matrix-closure engine (one jit dispatch per history).
+
+    ``adapter`` optionally re-expresses a legacy workload history as
+    txn ops first (see :mod:`comdb2_tpu.txn.adapters`) so the graph
+    checker can second-opinion the bespoke checkers. An adapter
+    returning an empty list yields ``unknown`` (nothing to check is
+    not a clean bill)."""
+
+    def __init__(self, backend: str = "auto", realtime: bool = False,
+                 adapter=None):
+        self.backend = backend
+        self.realtime = realtime
+        self.adapter = adapter
+
+    def check(self, test, model, history, opts=None):
+        from ..txn import check_txn
+
+        ops = list(history)
+        if self.adapter is not None:
+            ops = self.adapter(ops)
+            if not ops:
+                return {"valid?": UNKNOWN,
+                        "error": "adapter produced no txn ops"}
+        out = check_txn(ops, backend=self.backend,
+                        realtime=self.realtime)
+        if out["valid?"] is False:
+            self._render(test, out, opts)
+        return out
+
+    @staticmethod
+    def _render(test, result, opts) -> None:
+        """Drop ``serializable.txt`` + ``serializable.svg`` (the
+        decoded cycle) into the store dir on failure — best-effort,
+        like the linearizable checker's SVG."""
+        import os
+
+        from ..harness.store import artifact_dir
+
+        base = artifact_dir(test, opts)
+        if base is None:
+            return
+        try:
+            from ..report import txn_svg
+            from ..txn.counterexample import render_text
+
+            os.makedirs(base, exist_ok=True)
+            cex = result.get("counterexample")
+            with open(os.path.join(base, "serializable.txt"),
+                      "w") as fh:
+                if cex:
+                    fh.write(render_text(cex) + "\n")
+                for a in result.get("anomalies", ()):
+                    fh.write(f"{a}\n")
+            if cex:
+                txn_svg.render_cycle(
+                    cex, os.path.join(base, "serializable.svg"))
+        except Exception:
+            pass
+
+
+serializable = Serializable()
 
 
 class Queue(Checker):

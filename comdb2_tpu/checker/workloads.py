@@ -89,3 +89,37 @@ class G2Checker(Checker):
 
 
 g2_checker = G2Checker()
+
+
+# --- dependency-graph second opinions ---------------------------------------
+#
+# The bespoke checkers above each pattern-match ONE anomaly shape;
+# the txn dependency-graph checker (comdb2_tpu.txn) re-derives the
+# same verdicts from first principles (ww/wr/rw cycles, G1a). The
+# composed forms run both and merge by verdict priority — on the
+# seeded negative-control histories the two must agree, which is
+# exactly what tests/test_txn_cluster.py asserts.
+
+def _graph_second_opinion(adapter_name: str):
+    from ..txn import adapters
+    from .checkers import Serializable
+
+    return Serializable(backend="host",
+                        adapter=getattr(adapters, adapter_name))
+
+
+def g2_composed():
+    """Adya count shortcut + dependency-graph view of the same run."""
+    from .checkers import compose
+
+    return compose({"adya": g2_checker,
+                    "graph": _graph_second_opinion("g2_as_txns")})
+
+
+def dirty_reads_composed():
+    """Visible-failed-write scan + graph G1a view of the same run."""
+    from .checkers import compose
+
+    return compose({"dirty": dirty_reads_checker,
+                    "graph": _graph_second_opinion(
+                        "dirty_reads_as_txns")})

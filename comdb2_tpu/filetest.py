@@ -28,9 +28,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                    choices=sorted(MODELS),
                    help="consistency model (default cas-register)")
     p.add_argument("--checker", default="linear",
-                   choices=["linear", "set", "wgl"],
+                   choices=["linear", "set", "wgl", "txn"],
                    help="linear (frontier search), wgl (world search), "
-                        "or set semantics")
+                        "set semantics, or txn (serializability over "
+                        "list-append txn ops)")
+    p.add_argument("--txn", action="store_true",
+                   help="shorthand for --checker txn")
+    p.add_argument("--realtime", action="store_true",
+                   help="with --txn: include realtime edges (strict "
+                        "serializability)")
     p.add_argument("--backend", default="auto",
                    choices=["auto", "host", "device"])
     p.add_argument("--keyed", action="store_true",
@@ -43,6 +49,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "touched; exits 3 on a daemon error reply "
                         "(overload/bad-request: nothing was checked)")
     args = p.parse_args(argv)
+    if args.txn:
+        args.checker = "txn"
 
     if args.service:
         # remote path first: the whole point is NOT to attach this
@@ -55,9 +63,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             text = fh.read()
         try:
             with ServiceClient(host or "127.0.0.1", int(port)) as c:
-                reply = c.check(text, model=args.model,
-                                keyed=args.keyed,
-                                raise_on_error=False)
+                if args.checker == "txn":
+                    reply = c.check(text, txn=True,
+                                    realtime=args.realtime,
+                                    raise_on_error=False)
+                else:
+                    reply = c.check(text, model=args.model,
+                                    keyed=args.keyed,
+                                    raise_on_error=False)
         except (OSError, ValueError) as e:
             # unreachable daemon / bad HOST:PORT: nothing was checked
             # — exiting 1 would record a linearizability violation
@@ -78,7 +91,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         return 1
 
-    if args.checker == "linear" and args.backend != "host":
+    if args.checker in ("linear", "txn") and args.backend != "host":
         # only the device frontier search needs a JAX backend; the set
         # and wgl checkers (and host linear) are pure host Python, and
         # in the ambient env touching jax attaches the tunneled TPU
@@ -89,14 +102,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(args.history) as fh:
         history = parse_history(fh.read())
 
-    if args.keyed or args.model == "cas-register-comdb2":
+    if (args.keyed or args.model == "cas-register-comdb2") \
+            and args.checker != "txn":
         # the comdb2 tuple model exists solely for keyed histories;
-        # EDN [k v] vectors carry no type tag, so re-tag them here
+        # EDN [k v] vectors carry no type tag, so re-tag them here —
+        # NEVER for txn histories: their values are micro-op vectors,
+        # not [k v] pairs, and wrapping would corrupt them
         from .checker.independent import wrap_keyed_history
 
         history = wrap_keyed_history(history)
 
-    if args.checker == "set":
+    if args.checker == "txn":
+        from .txn import check_txn
+
+        result = check_txn(history, backend=args.backend,
+                           realtime=args.realtime)
+        cex = result.get("counterexample")
+        if cex:
+            from .txn.counterexample import render_text
+
+            print(render_text(cex))
+        pprint.pprint({k: v for k, v in result.items()
+                       if k != "counterexample"})
+        valid = result.get("valid?")
+    elif args.checker == "set":
         result = set_checker.check({}, None, history)
         pprint.pprint(result)
         valid = result.get("valid?")

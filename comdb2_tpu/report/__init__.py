@@ -12,7 +12,7 @@ import os
 from typing import Optional
 
 from ..checker.checkers import Checker, compose
-from . import perf, timeline, linear_svg
+from . import perf, timeline, linear_svg, txn_svg
 
 
 def _outdir(test: dict, opts: Optional[dict]) -> Optional[str]:

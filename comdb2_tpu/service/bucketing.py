@@ -49,6 +49,7 @@ class ServiceLimits:
     max_invokes_per_seg: int = 8  # the fused kernel's K cap
     max_slots: int = 16          # effective concurrency (P_eff)
     max_processes: int = 32      # raw process-table width
+    max_txns: int = 4096         # txn-kind graph nodes (closure N)
 
 
 class Bucket(NamedTuple):
@@ -118,4 +119,30 @@ def bucket_for(packed: PackedHistory,
                   P_eff=pe)
 
 
-__all__ = ["Bucket", "ServiceLimits", "bucket_for"]
+class TxnBucket(NamedTuple):
+    """One compiled-shape class of the txn closure engine: the only
+    jit-visible axis is the padded txn count N (pow2, floor
+    ``txn.edges.TXN_N_FLOOR``); the batch axis is pow2-padded at
+    dispatch like the check kind's."""
+
+    N: int
+
+    @property
+    def key(self) -> str:
+        return f"txn-n{self.N}"
+
+
+def txn_bucket_for(n_txns: int,
+                   limits: ServiceLimits) -> Optional[TxnBucket]:
+    """The closure bucket for an ``n_txns``-node dependency graph, or
+    None past the limit (host-SCC route — one slow request degrades
+    alone)."""
+    from ..txn.edges import TXN_N_FLOOR
+
+    if n_txns > limits.max_txns:
+        return None
+    return TxnBucket(N=_next_pow2(max(n_txns, 1), TXN_N_FLOOR))
+
+
+__all__ = ["Bucket", "ServiceLimits", "TxnBucket", "bucket_for",
+           "txn_bucket_for"]

@@ -103,11 +103,15 @@ class ServiceClient:
     def check(self, history: Union[str, List, None] = None, *,
               model: Optional[str] = None, keyed: bool = False,
               deadline_ms: Optional[int] = None,
+              txn: bool = False, realtime: bool = False,
               raise_on_error: bool = True) -> dict:
         """Verify one history. ``history`` is EDN text or a list of
-        ``Op``s (serialized via ``history_to_edn``). Returns the reply
-        dict (``valid`` is the tri-state); daemon-side errors raise
-        :class:`ServiceError` unless ``raise_on_error=False``."""
+        ``Op``s (serialized via ``history_to_edn``). ``txn=True``
+        submits the serializability kind (list-append txn ops; the
+        reply carries ``anomaly_class``/``cycle`` on violations).
+        Returns the reply dict (``valid`` is the tri-state);
+        daemon-side errors raise :class:`ServiceError` unless
+        ``raise_on_error=False``."""
         if not isinstance(history, str):
             from ..ops.history import history_to_edn
 
@@ -115,6 +119,10 @@ class ServiceClient:
         self._seq += 1
         req: dict = {"op": "check", "id": self._seq,
                      "history": history}
+        if txn:
+            req["kind"] = "txn"
+            if realtime:
+                req["realtime"] = True
         if model is not None:
             req["model"] = model
         if keyed:

@@ -33,7 +33,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..utils import next_pow2 as _next_pow2
 from . import protocol
-from .bucketing import Bucket, ServiceLimits, bucket_for
+from .bucketing import (Bucket, ServiceLimits, TxnBucket, bucket_for,
+                        txn_bucket_for)
 
 #: (n_events, batch copies) pairs primed at boot — one small and one
 #: mid bucket, each at the serial (B=1) and coalesced (B=cap) program
@@ -43,15 +44,21 @@ DEFAULT_PRIME: Tuple[Tuple[int, int], ...] = ((24, 1), (24, 8))
 @dataclass
 class PendingRequest:
     """One queued check; ``ctx`` is the transport's opaque handle (the
-    daemon stores the connection there)."""
+    daemon stores the connection there). ``kind`` is ``"check"``
+    (linearizability — ``packed`` holds the PackedHistory) or
+    ``"txn"`` (serializability — ``packed`` holds the inferred
+    TxnGraph); both kinds share the queue, the deadline expiry, and
+    the coalescing tick."""
 
     rid: object
     model: str
-    packed: object                       # PackedHistory
-    bucket: Optional[Bucket]             # None => host-engine route
+    packed: object                       # PackedHistory | TxnGraph
+    bucket: object                       # Bucket | TxnBucket | None
     t_in: float
     t_dead: Optional[float] = None
     ctx: object = None
+    kind: str = "check"
+    realtime: bool = False
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -122,10 +129,19 @@ class VerifierCore:
         rid = req.get("id")
         if len(self.queue) >= self.max_queue:
             # backpressure BEFORE parse: shedding load must stay O(1)
+            # — and before the kind split, so txn requests answer
+            # overload exactly like check requests
             self.m["overloads"] += 1
             return None, protocol.error_reply(
                 protocol.OVERLOAD,
                 f"admission queue at cap ({self.max_queue})", rid)
+        kind = req.get("kind", "check")
+        if kind == "txn":
+            return self._submit_txn(req, now, ctx, rid)
+        if kind != "check":
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unknown kind {kind!r}", rid)
         model = req.get("model") or self.model
         from ..models.model import MODELS
 
@@ -203,6 +219,87 @@ class VerifierCore:
             return None
         return pack_history(list(ops))
 
+    # -- txn-kind admission --------------------------------------------
+
+    def _submit_txn(self, req: dict, now: float, ctx: object, rid):
+        """Admit one serializability check. Same contract as the
+        check kind: immediate reply for trivial/malformed, queued
+        PendingRequest otherwise — from here on the txn request rides
+        the SAME tick loop, deadline expiry, and batch coalescing."""
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, "missing history (EDN text)", rid)
+        try:
+            # NEVER keyed-wrapped: txn values are micro-op vectors
+            from ..ops.native_loader import parse_history_fast
+
+            ops = parse_history_fast(text)
+        except Exception as e:              # noqa: BLE001 — client data
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unparseable history: {e}", rid)
+        realtime = bool(req.get("realtime"))
+        try:
+            from ..txn import infer_edges
+
+            graph = infer_edges(ops, realtime=realtime)
+        except ValueError as e:
+            self.m["malformed"] += 1
+            return None, self._reply(rid, "unknown",
+                                     cause=f"malformed: {e}")
+        self.m["accepted"] += 1
+        if not graph.adj.any():
+            # edge-free graphs never cycle — but direct anomalies
+            # (G1a, duplicates) still decide the verdict. Answered
+            # BEFORE deadline_ms validation, matching the check
+            # kind's trivial path (reply-parity contract)
+            from ..txn import check_txn
+
+            result = check_txn((), graph=graph, realtime=realtime)
+            self.m["completed"] += 1
+            return None, self._txn_reply(rid, result, engine="trivial")
+        dl = req.get("deadline_ms")
+        if dl is not None and not isinstance(dl, (int, float)):
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"deadline_ms must be a number, got {type(dl).__name__}",
+                rid)
+        bucket = txn_bucket_for(graph.n, self.limits)
+        pending = PendingRequest(
+            rid=rid, model="txn", packed=graph, bucket=bucket,
+            t_in=now, ctx=ctx, kind="txn", realtime=realtime,
+            t_dead=(now + float(dl) / 1e3) if dl is not None else None)
+        if bucket is not None:
+            self._bstats(bucket.key).requests += 1
+        self.queue.append(pending)
+        return pending, None
+
+    def _txn_reply(self, rid, result: dict, **extra) -> dict:
+        """Compress a check_txn result map into a wire reply."""
+        cex = result.get("counterexample")
+        out = self._reply(
+            rid, result["valid?"], kind="txn",
+            txn_count=result.get("txn-count", 0),
+            anomalies=[a["name"] for a in result.get("anomalies", ())],
+            **extra)
+        if result.get("malformed-ops"):
+            # the unknown tri-state always carries a cause
+            out["malformed_ops"] = result["malformed-ops"]
+            out.setdefault(
+                "cause", f"malformed: {result['malformed-ops']} "
+                         "unparseable txn op(s)")
+        if cex:
+            out["anomaly_class"] = cex["class"]
+            # full decode capped: replies ride next to latency-
+            # sensitive traffic, and a pathological cycle can span
+            # the whole graph
+            out["cycle"] = cex["cycle"][:16]
+            out["cycle_len"] = len(cex["cycle"])
+        return out
+
     # -- the tick ------------------------------------------------------
 
     def tick(self, now: Optional[float] = None):
@@ -216,9 +313,15 @@ class VerifierCore:
         work = list(self.queue)
         self.queue.clear()
         groups: Dict[tuple, List[PendingRequest]] = {}
+        txn_groups: Dict[TxnBucket, List[PendingRequest]] = {}
         hosts: List[PendingRequest] = []
         for p in work:
-            if p.bucket is None:
+            if p.kind == "txn":
+                if p.bucket is None:
+                    hosts.append(p)
+                else:
+                    txn_groups.setdefault(p.bucket, []).append(p)
+            elif p.bucket is None:
                 hosts.append(p)
             else:
                 groups.setdefault((p.model, p.bucket), []).append(p)
@@ -226,8 +329,15 @@ class VerifierCore:
             for i in range(0, len(items), self.batch_cap):
                 self._dispatch(model, bucket,
                                items[i:i + self.batch_cap], done)
+        for bucket, items in txn_groups.items():
+            for i in range(0, len(items), self.batch_cap):
+                self._dispatch_txn(bucket,
+                                   items[i:i + self.batch_cap], done)
         for p in hosts:
-            self._host_check(p, done)
+            if p.kind == "txn":
+                self._host_check_txn(p, done)
+            else:
+                self._host_check(p, done)
         return done
 
     def _expire(self, now: float, done: list) -> None:
@@ -237,8 +347,10 @@ class VerifierCore:
         for p in self.queue:
             if p.t_dead is not None and now >= p.t_dead:
                 self.m["deadline_expired"] += 1
+                extra = {"kind": "txn"} if p.kind == "txn" else {}
                 self._finish(p, self._reply(p.rid, "unknown",
-                                            cause="deadline"), done)
+                                            cause="deadline",
+                                            **extra), done)
             else:
                 live.append(p)
         self.queue = live
@@ -308,6 +420,74 @@ class VerifierCore:
             self._finish(p, self._reply(p.rid, "unknown",
                                         cause=f"engine: {cause}",
                                         bucket=bucket.key), done)
+
+    def _dispatch_txn(self, bucket: TxnBucket,
+                      items: List[PendingRequest], done: list) -> None:
+        """ONE device dispatch for a txn bucket's chunk: every graph
+        pads to the bucket's N, the batch axis pow2-pads with copies
+        of the first adjacency, and the whole stack rides a single
+        ``closure_diag_batch`` call (the per-item-dispatch rule).
+        Mixed realtime flags coexist in one batch — a request without
+        realtime edges simply ships an all-zero rt plane."""
+        import numpy as np
+
+        from ..txn.check import verdict_map
+        from ..txn.closure_jax import closure_diag_batch
+        from ..txn.counterexample import decode
+
+        t0 = time.monotonic()
+        adjs = [p.packed.padded(bucket.N) for p in items]
+        b_prog = _next_pow2(len(adjs))
+        adjs = adjs + [adjs[0]] * (b_prog - len(adjs))
+        try:
+            diag = closure_diag_batch(np.stack(adjs))
+        except Exception as e:                  # noqa: BLE001
+            self.m["engine_errors"] += 1
+            for p in items:
+                self._finish(p, self._reply(
+                    p.rid, "unknown", kind="txn",
+                    cause=f"engine: {type(e).__name__}: {e}",
+                    bucket=bucket.key), done)
+            return
+        if self.inject_dispatch_latency_s > 0.0:
+            time.sleep(self.inject_dispatch_latency_s)
+        pk = ("txn", bucket.key, b_prog)
+        bs = self._bstats(bucket.key)
+        bs.dispatches += 1
+        bs.batched += len(items)
+        bs.occupancy_sum += len(items) / b_prog
+        bs.device_s += time.monotonic() - t0
+        if pk in self._programs:
+            self.m["program_hits"] += 1
+        else:
+            self._programs.add(pk)
+            bs.compiles += 1
+            self.m["compiles"] += 1
+        bs.programs.add(pk)
+        self.m["dispatches"] += 1
+        for i, p in enumerate(items):
+            g = p.packed
+            cex = decode(g, diag[i][:, :g.n], realtime=p.realtime)
+            self._finish(p, self._txn_reply(
+                p.rid, verdict_map(g, cex), engine="closure",
+                bucket=bucket.key, batched=len(items)), done)
+
+    def _host_check_txn(self, p: PendingRequest, done: list) -> None:
+        """Over-limit txn graphs degrade to the host SCC engine, one
+        request at a time — same contract as the linear host route."""
+        from ..txn import check_txn
+
+        self.m["host_degraded"] += 1
+        try:
+            result = check_txn((), graph=p.packed, backend="host",
+                               realtime=p.realtime)
+            reply = self._txn_reply(p.rid, result, engine="host",
+                                    degraded=True)
+        except Exception as e:                  # noqa: BLE001
+            reply = self._reply(p.rid, "unknown", kind="txn",
+                                cause=f"host engine: {e}",
+                                engine="host", degraded=True)
+        self._finish(p, reply, done)
 
     def _host_check(self, p: PendingRequest, done: list) -> None:
         """Out-of-bucket degradation: the host engine checks this one

@@ -1,0 +1,79 @@
+"""The serializability pipeline: history -> edges -> cycles -> verdict.
+
+``check_txn`` is the single entry point every surface shares (the
+``Serializable`` checker class, ``filetest --txn``, the service's
+``txn`` request kind, and the cluster anomaly tests). Verdict map::
+
+    {"valid?": True | False | "unknown",
+     "txn-count": n, "edge-count": e,
+     "anomalies": [...direct anomalies...],     # G1a / duplicate / ...
+     "counterexample": {"class": "G2-item", "cycle": [...]} | None}
+
+Backends: ``host`` (Tarjan SCC), ``device`` (matrix closure, one jit
+dispatch), ``auto`` (host below ``DEVICE_THRESHOLD`` txns — tiny
+graphs are cheaper than one tunnel round-trip; device above).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.op import Op
+from .edges import TxnGraph, infer_edges
+
+#: auto-backend crossover: below this many txns the ~100 ms dispatch
+#: round-trip dwarfs a host SCC over a sparse graph
+DEVICE_THRESHOLD = 1024
+
+
+def check_txn(history: Sequence[Op],
+              backend: str = "auto",
+              realtime: bool = False,
+              graph: Optional[TxnGraph] = None) -> dict:
+    """Check a txn history for serializability. Malformed histories
+    raise ``ValueError`` (callers map that to unknown/bad-request —
+    same contract as the linear pipeline's packing)."""
+    g = graph if graph is not None else infer_edges(history,
+                                                   realtime=realtime)
+    cex = None
+    if g.n and g.adj.any():
+        if backend == "host" or (backend == "auto"
+                                 and g.n < DEVICE_THRESHOLD):
+            from .scc import cyclic_layers_host
+
+            diag = cyclic_layers_host(g.adj, realtime=realtime)
+        else:
+            from .closure_jax import cyclic_layers_device
+
+            diag = cyclic_layers_device(g.adj, realtime=realtime)
+        from .counterexample import decode
+
+        cex = decode(g, np.asarray(diag), realtime=realtime)
+    return verdict_map(g, cex)
+
+
+def verdict_map(graph: TxnGraph, cex: Optional[dict]) -> dict:
+    """The verdict for an inferred graph + decoded counterexample —
+    the ONE place the tri-state is computed, shared by every surface
+    (check_txn here, the service's coalesced dispatch) so a partially
+    unparseable history answers ``unknown`` identically everywhere."""
+    anomalies = [a for a in graph.anomalies if a["name"] != "malformed"]
+    malformed = len(graph.anomalies) - len(anomalies)
+    valid = not anomalies and cex is None
+    if valid and malformed:
+        valid = "unknown"                # something was unparseable
+    out = {
+        "valid?": valid,
+        "txn-count": graph.n,
+        "edge-count": int(graph.adj.sum()),
+        "anomalies": anomalies,
+        "counterexample": cex,
+    }
+    if malformed:
+        out["malformed-ops"] = malformed
+    return out
+
+
+__all__ = ["DEVICE_THRESHOLD", "check_txn", "verdict_map"]

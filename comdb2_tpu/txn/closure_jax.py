@@ -1,0 +1,141 @@
+"""Device cycle engine — boolean transitive closure on the MXU.
+
+Cycle detection over a txn dependency graph is matmul-shaped (the
+tensor-core BFS observation, PAPERS.md): with A the adjacency matrix,
+``A+ = A | A^2 | A^4 | ...`` converges in ceil(log2 N) squarings, and
+a vertex is on a cycle iff ``A+[v, v]``. All three Adya layers ride
+one ``(3, N, N)`` stacked operand so a single jit dispatch classifies
+G0 / G1c / G2-item — never a per-edge or per-layer device call (the
+~100 ms tunnel round-trip rule; the ``per-item-dispatch`` analysis
+rule names this module's entry points).
+
+Transfer economics on the tunneled link (~25 MB/s): adjacency bits
+ship PACKED (``np.packbits``, 8x smaller — 6 MB instead of 48 MB at
+the 4096 bucket) and unpack on device; the readback is only the
+``(3, N)`` diagonal mask. N is pow2-bucketed (floor
+``edges.TXN_N_FLOOR``) so the compiled-program set stays closed, and
+the batch axis is pow2 too (service convention).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+#: dispatch counter — bench_txn asserts the single-dispatch rule on it
+DISPATCHES = 0
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _unpack_bits(packed, n: int):
+    """(..., N/8) uint8 -> (..., N) bool (device side)."""
+    jnp = _jnp()
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)   # packbits is MSB-first
+    bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+    return bits.reshape(*packed.shape[:-1], n).astype(bool)
+
+
+def _closure_step(g):
+    """One squaring: g | g.g — g is (..., 3, N, N) bool. The matmul
+    rides the MXU as bf16 with f32 accumulation, which is EXACT here:
+    operands are 0/1 (bf16-representable) and every partial sum is
+    non-negative, so a true reachability count can never cancel or
+    round to zero — only the > 0 bit survives anyway."""
+    jnp = _jnp()
+    gb = g.astype(jnp.bfloat16)
+    sq = jnp.einsum("...ij,...jk->...ik", gb, gb,
+                    preferred_element_type=jnp.float32)
+    return g | (sq > 0)
+
+
+def _build_layers(planes, n: int):
+    """(..., 4, N, N/8) packed planes -> (..., 3, N, N) cumulative
+    Adya layers (ww; ww|wr; ww|wr|rw), with the rt plane OR-ed into
+    every layer (it is shipped all-zero when realtime is off — one
+    program serves both modes)."""
+    jnp = _jnp()
+    a = _unpack_bits(planes, n)                       # (..., 4, N, N)
+    ww, wr, rw, rt = (a[..., i, :, :] for i in range(4))
+    l0 = ww | rt
+    l1 = l0 | wr
+    l2 = l1 | rw
+    return jnp.stack([l0, l1, l2], axis=-3)
+
+
+def _diag_kernel(planes, n: int):
+    g = _build_layers(planes, n)
+    # ceil(log2 n) squarings reach the full closure; the trip count is
+    # static per bucket so the loop unrolls into one fused program
+    for _ in range(max(1, (n - 1).bit_length())):
+        g = _closure_step(g)
+    jnp = _jnp()
+    eye = jnp.eye(n, dtype=bool)
+    return jnp.any(g & eye, axis=-1)                  # (..., 3, N)
+
+
+_JITTED = {}
+
+
+def _jitted(n: int):
+    """One jit wrapper per N bucket (jax.jit itself specializes per
+    input shape, so the single and batched entries share it)."""
+    import jax
+
+    fn = _JITTED.get(n)
+    if fn is None:
+        fn = jax.jit(partial(_diag_kernel, n=n))
+        _JITTED[n] = fn
+    return fn
+
+
+def _pack(adj: np.ndarray) -> np.ndarray:
+    return np.packbits(adj.astype(np.uint8), axis=-1)
+
+
+def closure_diag(adj: np.ndarray) -> np.ndarray:
+    """(4, N, N) bool planes -> (3, N) bool per-layer cyclic-vertex
+    mask. ONE device dispatch; N must be pow2 (use
+    ``TxnGraph.padded``)."""
+    global DISPATCHES
+    n = adj.shape[-1]
+    out = _jitted(n)(_pack(adj))
+    DISPATCHES += 1
+    return np.asarray(out)
+
+
+def closure_diag_batch(adjs: np.ndarray) -> np.ndarray:
+    """(B, 4, N, N) bool -> (B, 3, N) bool. ONE dispatch for the whole
+    batch — the service's coalesced path (B pow2-padded by the
+    caller)."""
+    global DISPATCHES
+    n = adjs.shape[-1]
+    out = _jitted(n)(_pack(adjs))
+    DISPATCHES += 1
+    return np.asarray(out)
+
+
+def cyclic_layers_device(adj: np.ndarray,
+                         realtime: bool = False) -> np.ndarray:
+    """Device twin of :func:`scc.cyclic_layers_host` over UNPADDED
+    (4, n, n) planes: pads to the bucket, masks rt when realtime is
+    off, and trims the answer back to n."""
+    from .edges import TXN_N_FLOOR
+    from ..utils import next_pow2
+
+    n = adj.shape[-1]
+    np2 = next_pow2(max(n, 1), TXN_N_FLOOR)
+    padded = np.zeros((4, np2, np2), dtype=bool)
+    padded[:, :n, :n] = adj
+    if not realtime:
+        padded[3] = False
+    return closure_diag(padded)[:, :n]
+
+
+__all__ = ["DISPATCHES", "closure_diag", "closure_diag_batch",
+           "cyclic_layers_device"]

@@ -305,6 +305,65 @@ class G2Client(client_ns.Client):
         return {**op, "type": "ok"}
 
 
+class ListAppendClient(client_ns.Client):
+    """Elle's list-append workload over the table interface: a txn op's
+    value is a sequence of micro-ops ``("append", k, v)`` /
+    ``("r", k, None)``; appends insert ``{key, v}`` rows into the
+    ``la`` table, reads select the key's rows in insertion order (the
+    whole list — version order is recoverable). Reads within one txn
+    see the txn's OWN earlier appends (buffered-write fixup) so the
+    history honors the standard list-append semantics."""
+
+    def __init__(self, connect: Callable[[], Conn]):
+        self.connect = connect
+        self.conn: Optional[Conn] = None
+
+    def setup(self, test, node):
+        c = ListAppendClient(self.connect)
+        c.conn = self.connect()
+        return c
+
+    @_invoke_guard
+    def invoke(self, test, op):
+        done = []
+        own: dict = {}
+        with self.conn.transaction() as t:
+            for f, k, v in op["value"]:
+                if f == "append":
+                    t.insert("la", {"key": k, "v": v})
+                    own.setdefault(k, []).append(v)
+                    done.append(("append", k, v))
+                else:
+                    rows = t.select("la", lambda r, k=k: r["key"] == k)
+                    vals = [r["v"] for r in rows] + own.get(k, [])
+                    done.append(("r", k, tuple(vals)))
+        return {**op, "type": "ok", "value": tuple(done)}
+
+
+def list_append_gen(n_keys: int = 3, max_micro: int = 3):
+    """Txn invocations with unique per-key append values (the Elle
+    precondition) — thread-safe counters shared by all workers."""
+    counters = [0] * n_keys
+    lock = threading.Lock()
+
+    def next_val(k):
+        with lock:
+            counters[k] += 1
+            return counters[k]
+
+    def gen_op(test=None, process=None):
+        mops = []
+        for _ in range(random.randint(1, max_micro)):
+            k = random.randrange(n_keys)
+            if random.random() < 0.5:
+                mops.append(("append", k, next_val(k)))
+            else:
+                mops.append(("r", k, None))
+        return {"type": "invoke", "f": "txn", "value": tuple(mops)}
+
+    return gen_op
+
+
 def g2_gen():
     """Concurrent unique keys, two inserts per key with globally unique
     ids, 2 threads per key — the reference's shape exactly

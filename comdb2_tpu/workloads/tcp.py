@@ -859,6 +859,38 @@ class DirtyReadsTcpClient(_ClusterTxnClientBase):
         raise ValueError(f"unknown f {op['f']!r}")
 
 
+class ListAppendTcpClient(_ClusterTxnClientBase):
+    """Elle's list-append workload over the wire txn surface: appends
+    are inserts into table ``a`` at ``BASE + k`` (insert-only, so the
+    server's per-(table, key) row-count validation gives appends the
+    same conflict rules as the G2 workload), reads are predicate
+    reads returning the key's rows in log order — the WHOLE list,
+    so committed reads recover the version order Elle-style. Reads
+    see the txn's own buffered appends (client-side fixup: the wire
+    predicate read serves the committed prefix only)."""
+
+    BASE = 30_000
+
+    def _clone(self):
+        return ListAppendTcpClient(self.ports, self.timeout_s)
+
+    def invoke(self, test, op):
+        def body(txn):
+            done = []
+            own: dict = {}
+            for f, k, v in op["value"]:
+                if f == "append":
+                    txn.insert("a", self.BASE + k, v, v)
+                    own.setdefault(k, []).append(v)
+                    done.append(("append", k, v))
+                else:
+                    rows = txn.predicate("a", self.BASE + k)
+                    vals = [val for _rid, val in rows] + own.get(k, [])
+                    done.append(("r", k, tuple(vals)))
+            return {**op, "type": "ok", "value": tuple(done)}
+        return self._run_txn(op, body)
+
+
 class CounterTcpClient(_ClusterTxnClientBase):
     """The counter workload over the wire (``checker.clj:220-272``):
     ``add v`` reads the counter register and writes back the sum in one

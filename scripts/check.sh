@@ -46,6 +46,28 @@ exec 3<&- 3>&-
 wait "$PMUX_PID"   # non-zero (ASan abort) fails the check
 trap - EXIT
 
+echo "== txn serializability checker smoke (host engine) =="
+# the seeded G2 write-skew fixture MUST be caught (exit 1 = invalid);
+# a miss (exit 0) or a give-up (exit 2) fails the repo check — and
+# the clean twin must pass, so the detector can't cheat by flagging
+# everything
+set +e
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --txn --backend host \
+    tests/fixtures/txn/g2_item.edn >/dev/null
+RC_BAD=$?
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --txn --backend host \
+    tests/fixtures/txn/clean.edn >/dev/null
+RC_CLEAN=$?
+set -e
+if [ "$RC_BAD" -ne 1 ]; then
+    echo "txn checker MISSED the seeded G2-item cycle (rc=$RC_BAD)"
+    exit 1
+fi
+if [ "$RC_CLEAN" -ne 0 ]; then
+    echo "txn checker flagged the clean fixture (rc=$RC_CLEAN)"
+    exit 1
+fi
+
 echo "== verifier service smoke (CPU backend) =="
 # zombie baseline BEFORE the daemon runs: the post-shutdown check
 # below must catch NEW zombies (a reaped child can't show Z, so the
@@ -102,4 +124,5 @@ if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
 fi
 
 echo "OK: checker clean, ASan build clean, ct_pmux shutdown clean," \
-     "verifier service shutdown clean"
+     "txn smoke caught the seeded cycle, verifier service shutdown" \
+     "clean"

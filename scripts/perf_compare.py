@@ -97,6 +97,29 @@ def main() -> None:
         print("pallas-fused            unavailable for this "
               "backend/shape", flush=True)
 
+    # the txn closure engine on the serializability axis: one strict-
+    # serializability (dense realtime) graph at the 1024 bucket,
+    # device closure vs host Tarjan (scripts/bench_txn.py sweeps the
+    # full ladder and writes BENCH_txn.json)
+    import numpy as np
+
+    from bench_txn import make_graph
+    from comdb2_tpu.txn import closure_jax as CJ
+    from comdb2_tpu.txn.scc import cyclic_layers_host
+
+    adj = make_graph(random.Random(7), 1024, dense=True)
+    CJ.closure_diag(adj)                       # warm the program
+    t0 = time.perf_counter()
+    dd = CJ.closure_diag(adj)
+    dt_dev = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dh = cyclic_layers_host(adj, realtime=True)
+    dt_host = time.perf_counter() - t0
+    assert np.array_equal(dh, dd), "txn engines disagree"
+    print(f"{'txn-closure n1024':24s} {dt_dev:10.4f} s   "
+          f"(host SCC {dt_host:.4f} s, x{dt_host / dt_dev:.1f})",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,17 @@
+"""Seeded violation: per-graph device dispatch in a host loop — the
+txn-checker flavor of ``bad_dispatch_loop.py``. Each ``closure_diag``
+call pays the ~100 ms tunnel round-trip; N dependency graphs must be
+padded to one bucket and stacked through ``closure_diag_batch`` (or
+submitted to the verifier daemon's ``txn`` request kind)."""
+
+import numpy as np
+
+from comdb2_tpu.txn.closure_jax import closure_diag
+
+
+def classify_all(graphs):
+    out = []
+    for g in graphs:
+        out.append(closure_diag(              # <- per-item-dispatch
+            g.padded(np.int32(64))))
+    return out

@@ -318,3 +318,188 @@ def test_parallel_shim_reexports_sharding():
     assert shim.make_mesh is sharding.make_mesh
     assert shim.check_histories_sharded is \
         sharding.check_histories_sharded
+
+
+# --- the txn (serializability) request kind ---------------------------------
+#
+# Same queue, same tick, same overload/deadline answers as the check
+# kind; the device work is the matrix-closure engine, coalesced per
+# pow2 txn-count bucket.
+
+from comdb2_tpu.ops.synth import (list_append_history,
+                                  txn_anomaly_history)
+from comdb2_tpu.service.bucketing import TxnBucket, txn_bucket_for
+
+
+def _submit_txn(core, h, **fields):
+    return core.submit({"op": "check", "kind": "txn",
+                        "history": history_to_edn(list(h)),
+                        **fields}, time.monotonic())
+
+
+def test_txn_bucket_quantized_and_limited():
+    limits = ServiceLimits()
+    assert txn_bucket_for(3, limits) == TxnBucket(N=16)
+    assert txn_bucket_for(17, limits) == TxnBucket(N=32)
+    assert txn_bucket_for(limits.max_txns + 1, limits) is None
+    assert TxnBucket(N=64).key == "txn-n64"
+
+
+def test_txn_requests_coalesce_and_classify():
+    core = _core()
+    p1, r1 = _submit_txn(core, txn_anomaly_history("g2-item"))
+    p2, r2 = _submit_txn(core, list_append_history(
+        random.Random(3), 3, 10, 2))
+    assert r1 is None and r2 is None
+    assert p1.bucket == p2.bucket == TxnBucket(N=16)
+    done = core.tick()
+    assert len(done) == 2
+    bad = next(r for _, r in done if r["valid"] is False)
+    good = next(r for _, r in done if r["valid"] is True)
+    assert bad["anomaly_class"] == "G2-item"
+    assert bad["batched"] == 2 and bad["engine"] == "closure"
+    assert [s["edge"]["type"] for s in bad["cycle"]] == ["rw", "rw"]
+    assert good["kind"] == "txn"
+    st = core.status()
+    assert st["buckets"]["txn-n16"]["dispatches"] == 1
+    assert st["buckets"]["txn-n16"]["batched"] == 2
+
+
+def test_txn_and_check_kinds_share_one_tick():
+    core = _core()
+    _submit(core, register_history(random.Random(0), 3, 24,
+                                   p_info=0.0))
+    _submit_txn(core, txn_anomaly_history("clean"))
+    done = core.tick()
+    kinds = sorted(r.get("kind", "check") for _, r in done)
+    assert kinds == ["check", "txn"]
+
+
+def test_txn_program_reuse_across_ticks():
+    core = _core()
+    for seed in (1, 2):
+        _submit_txn(core, list_append_history(
+            random.Random(seed), 3, 10, 2))
+        done = core.tick()
+        assert done[-1][1]["valid"] is True
+    bs = core.status()["buckets"]["txn-n16"]
+    assert bs["dispatches"] == 2 and bs["compiles"] == 1
+    assert core.m["program_hits"] >= 1
+
+
+def test_txn_deadline_parity():
+    core = _core()
+    _submit_txn(core, txn_anomaly_history("g2-item"), deadline_ms=0)
+    time.sleep(0.002)
+    ((_, reply),) = core.tick()
+    assert reply["valid"] == "unknown" and reply["cause"] == "deadline"
+    assert core.m["deadline_expired"] == 1
+    _, bad = _submit_txn(core, txn_anomaly_history("g2-item"),
+                         deadline_ms="soon")
+    assert bad == {"ok": False, "error": "bad-request",
+                   "message": bad["message"]}
+
+
+def test_txn_overload_parity():
+    core = _core(max_queue=1)
+    assert _submit_txn(core, txn_anomaly_history("g2-item"))[1] is None
+    _, reply = _submit_txn(core, txn_anomaly_history("g2-item"))
+    assert reply == {"ok": False, "error": "overload",
+                     "message": reply["message"]}
+    assert core.m["overloads"] == 1
+    # and a check-kind request sheds identically at the shared cap
+    _, reply = _submit(core, register_history(random.Random(1), 3, 24,
+                                              p_info=0.0))
+    assert reply["error"] == "overload"
+
+
+def test_txn_trivial_and_direct_anomalies_answer_immediately():
+    core = _core()
+    # edge-free but anomalous: a doubled value nobody ever appended
+    # leaves no edges, so no cycle engine runs — yet the verdict is
+    # already decided at admission
+    h = [O.invoke(0, "txn", (("r", 0, None),)),
+         O.Op(0, "ok", "txn", (("r", 0, (1, 1)),))]
+    _, reply = _submit_txn(core, h)
+    assert reply is not None and reply["valid"] is False
+    assert "duplicate" in reply["anomalies"]
+    assert reply["engine"] == "trivial"
+    # edge-free and clean: immediate valid
+    h = [O.invoke(0, "txn", (("append", 0, 1),)),
+         O.Op(0, "ok", "txn", (("append", 0, 1),))]
+    _, reply = _submit_txn(core, h)
+    assert reply is not None and reply["valid"] is True
+
+
+def test_txn_over_limit_degrades_to_host_scc():
+    core = _core(limits=ServiceLimits(max_txns=2))
+    p, reply = _submit_txn(core, txn_anomaly_history("g2-item"))
+    assert reply is None and p.bucket is None
+    ((_, reply),) = core.tick()
+    assert reply["engine"] == "host" and reply["degraded"]
+    assert reply["valid"] is False
+    assert reply["anomaly_class"] == "G2-item"
+    assert core.m["host_degraded"] == 1
+
+
+def test_txn_malformed_answers_unknown_or_bad_request():
+    core = _core()
+    # double-pending process: malformed -> unknown (same contract as
+    # the check kind's pack failures)
+    h = [O.invoke(0, "txn", (("append", 0, 1),)),
+         O.invoke(0, "txn", (("append", 0, 2),))]
+    _, reply = _submit_txn(core, h)
+    assert reply["valid"] == "unknown"
+    assert "malformed" in reply["cause"]
+    # garbage EDN -> bad-request
+    _, reply = core.submit({"op": "check", "kind": "txn",
+                            "history": "{:not-an-op"},
+                           time.monotonic())
+    assert reply["error"] == "bad-request"
+
+
+def test_txn_realtime_flag_strictens():
+    # serializable but NOT strictly so: t1's read is STALE — it ran
+    # wholly after t0's append committed yet observed nothing, so the
+    # only valid serialization (t1 before t0) contradicts realtime
+    h = [O.invoke(0, "txn", (("append", 0, 7),)),
+         O.Op(0, "ok", "txn", (("append", 0, 7),)),
+         O.invoke(1, "txn", (("r", 0, None),)),
+         O.Op(1, "ok", "txn", (("r", 0, ()),)),
+         O.invoke(2, "txn", (("r", 0, None),)),
+         O.Op(2, "ok", "txn", (("r", 0, (7,)),))]
+    core = _core()
+    p, r = _submit_txn(core, h)
+    if r is None:
+        ((_, r),) = core.tick()
+    assert r["valid"] is True, r
+    p, r2 = _submit_txn(core, h, realtime=True)
+    if r2 is None:
+        ((_, r2),) = core.tick()
+    assert r2["valid"] is False, r2     # rw against realtime order
+
+
+def test_txn_partially_malformed_answers_unknown_from_batch():
+    """A history WITH edges plus one unparseable micro-op must answer
+    unknown from the coalesced dispatch path — identical to what
+    check_txn answers on every other surface (review regression)."""
+    h = list(txn_anomaly_history("clean"))
+    h += [O.invoke(9, "txn", (("x", 0, 1),)),
+          O.Op(9, "ok", "txn", (("x", 0, 1),))]
+    core = _core()
+    p, r = _submit_txn(core, h)
+    assert r is None                     # queued: the graph has edges
+    ((_, reply),) = core.tick()
+    assert reply["valid"] == "unknown", reply
+    assert reply["malformed_ops"] == 1
+    assert "malformed" in reply["cause"]
+    from comdb2_tpu.txn import check_txn
+    assert check_txn(h, backend="host")["valid?"] == "unknown"
+
+
+def test_txn_deadline_reply_carries_kind():
+    core = _core()
+    _submit_txn(core, txn_anomaly_history("g2-item"), deadline_ms=0)
+    time.sleep(0.002)
+    ((_, reply),) = core.tick()
+    assert reply["kind"] == "txn" and reply["cause"] == "deadline"

@@ -501,3 +501,198 @@ def test_counter_buggy_txn_lost_update_detected():
     finally:
         conn.close()
         _kill(procs)
+
+
+# --- list-append + dependency-graph checker (the txn/ subsystem) ------------
+#
+# The graph checker sees what the bespoke per-flag checkers cannot:
+# one engine classifies ANY ww/wr/rw cycle (G0 / G1c / G2-item) and
+# the direct anomalies (G1a, duplicates), so the -T and -R negative
+# controls get their verdicts from first principles. Per CLAUDE.md
+# the interleavings are driven exactly — no stochastic retries.
+
+from comdb2_tpu.checker.checkers import Serializable
+from comdb2_tpu.checker.workloads import (dirty_reads_composed,
+                                          g2_composed)
+from comdb2_tpu.txn import check_txn
+from comdb2_tpu.workloads.tcp import ListAppendTcpClient
+
+
+def test_list_append_over_cluster_valid(tmp_path):
+    """Clean -e 500 -l 300 cluster: the harness list-append workload
+    passes the dependency-graph checker (acceptance criterion)."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=500,
+                          elect_ms=500, lease_ms=300)
+    try:
+        from comdb2_tpu.workloads import comdb2 as W
+
+        t = fake.noop_test()
+        t.update({
+            "nodes": [], "concurrency": 5, "name": "la-cluster",
+            "store-root": str(tmp_path / "store"),
+            "client": ListAppendTcpClient(ports, timeout_s=0.6),
+            "model": None,
+            "generator": G.clients(G.time_limit(4.0, G.stagger(
+                0.01, W.list_append_gen(n_keys=3)))),
+            "checker": Serializable(backend="host"),
+        })
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid?"] is True, res
+        assert res["txn-count"] >= 20, res
+        assert res["edge-count"] >= 10, res
+    finally:
+        _kill(procs)
+
+
+def test_buggy_txn_control_yields_g2_cycle():
+    """-T end to end, deterministic write skew: both txns read the
+    other's key as empty, both append, both commit (validation
+    skipped). The graph checker must find the rw/rw cycle and class
+    it G2-item; the same interleaving on a correct cluster must
+    abort one txn and check valid."""
+    for buggy in (True, False):
+        ports = _free_ports(3)
+        procs = spawn_cluster(BINARY, ports, durable=True,
+                              timeout_ms=800,
+                              flags=["-T"] if buggy else [])
+        conn = _conn(ports[0])
+        try:
+            t1 = ClusterTxn(conn)
+            t1.begin()
+            r1 = tuple(v for _r, v in t1.predicate(
+                "a", ListAppendTcpClient.BASE + 0))
+            t2 = ClusterTxn(conn)
+            t2.begin()
+            r2 = tuple(v for _r, v in t2.predicate(
+                "a", ListAppendTcpClient.BASE + 1))
+            assert r1 == r2 == ()
+            t1.insert("a", ListAppendTcpClient.BASE + 1, 1, 1)
+            t2.insert("a", ListAppendTcpClient.BASE + 0, 2, 2)
+            assert t1.commit() == "ok"
+            second = t2.commit()
+
+            rd = ClusterTxn(conn)
+            rd.begin()
+            fx = tuple(v for _r, v in rd.predicate(
+                "a", ListAppendTcpClient.BASE + 0))
+            fy = tuple(v for _r, v in rd.predicate(
+                "a", ListAppendTcpClient.BASE + 1))
+            rd.commit()
+
+            hist = [
+                Op(0, "invoke", "txn", (("r", 0, None),
+                                        ("append", 1, 1))),
+                Op(0, "ok", "txn", (("r", 0, r1), ("append", 1, 1))),
+                Op(1, "invoke", "txn", (("r", 1, None),
+                                        ("append", 0, 2))),
+                Op(1, "ok" if second == "ok" else "fail", "txn",
+                   (("r", 1, r2), ("append", 0, 2))),
+                Op(2, "invoke", "txn", (("r", 0, None),
+                                        ("r", 1, None))),
+                Op(2, "ok", "txn", (("r", 0, fx), ("r", 1, fy))),
+            ]
+            res = check_txn(hist, backend="host")
+            if buggy:
+                assert second == "ok"
+                assert fx == (2,) and fy == (1,)
+                assert res["valid?"] is False, res
+                assert res["counterexample"]["class"] == "G2-item", res
+                types = {s["edge"]["type"]
+                         for s in res["counterexample"]["cycle"]}
+                assert types == {"rw"}
+            else:
+                assert second == "fail"      # validation caught it
+                assert res["valid?"] is True, res
+        finally:
+            conn.close()
+            _kill(procs)
+
+
+def test_dirty_commit_control_yields_g1a_and_cycle():
+    """-R end to end, deterministic: t2 conflicts with t1, the server
+    applies t2's append anyway while reporting FAIL; a later read
+    observes it. The graph checker must flag G1a (aborted read) AND
+    the lost-update cycle through the dirty txn (ww + rw = G2-item,
+    the strongest cycle an atomic-commit OCC server can produce —
+    docs/serializability.md explains why honest G1c cannot arise
+    here)."""
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800,
+                          flags=["-R"])
+    conn = _conn(ports[0])
+    try:
+        k = ListAppendTcpClient.BASE + 5
+        t1 = ClusterTxn(conn)
+        t1.begin()
+        r1 = tuple(v for _r, v in t1.predicate("a", k))
+        t2 = ClusterTxn(conn)
+        t2.begin()
+        r2 = tuple(v for _r, v in t2.predicate("a", k))
+        assert r1 == r2 == ()
+        t1.insert("a", k, 1, 1)
+        t2.insert("a", k, 2, 2)
+        assert t1.commit() == "ok"
+        assert t2.commit() == "fail"     # the lie: it actually applied
+
+        rd = ClusterTxn(conn)
+        rd.begin()
+        seen = tuple(v for _r, v in rd.predicate("a", k))
+        rd.commit()
+        assert seen == (1, 2), seen      # failed append visible
+
+        hist = [
+            Op(0, "invoke", "txn", (("r", 5, None), ("append", 5, 1))),
+            Op(0, "ok", "txn", (("r", 5, r1), ("append", 5, 1))),
+            Op(1, "invoke", "txn", (("r", 5, None), ("append", 5, 2))),
+            Op(1, "fail", "txn", (("r", 5, r2), ("append", 5, 2))),
+            Op(2, "invoke", "txn", (("r", 5, None),)),
+            Op(2, "ok", "txn", (("r", 5, seen),)),
+        ]
+        res = check_txn(hist, backend="host")
+        assert res["valid?"] is False, res
+        assert any(a["name"] == "G1a" for a in res["anomalies"]), res
+        assert res["counterexample"] is not None, res
+        assert res["counterexample"]["class"] == "G2-item", res
+        # the cycle runs THROUGH the dirty txn
+        statuses = {s["status"] for s in res["counterexample"]["cycle"]}
+        assert "fail (dirty)" in statuses, statuses
+    finally:
+        conn.close()
+        _kill(procs)
+
+
+def test_second_opinions_agree_on_seeded_controls():
+    """Cross-wiring satellite: the composed (bespoke + graph)
+    checkers agree on the seeded -T G2 interleaving and the -R
+    dirty-read interleaving, for both the anomalous and healthy
+    variants."""
+    g2_hist_bad = [
+        Op(0, "invoke", "insert", (7, (1, None))),
+        Op(0, "ok", "insert", (7, (1, None))),
+        Op(1, "invoke", "insert", (7, (None, 2))),
+        Op(1, "ok", "insert", (7, (None, 2))),
+    ]
+    g2_hist_good = [op.with_(type="fail") if i == 3 else op
+                    for i, op in enumerate(g2_hist_bad)]
+    checker = g2_composed()
+    for hist, expect in ((g2_hist_bad, False), (g2_hist_good, True)):
+        res = checker.check(None, None, hist)
+        assert res["valid?"] is expect, res
+        assert res["adya"]["valid?"] is expect
+        assert res["graph"]["valid?"] is expect
+
+    dirty_bad = [
+        Op(0, "invoke", "write", 7), Op(0, "ok", "write", 7),
+        Op(1, "invoke", "write", 8), Op(1, "fail", "write", 8),
+        Op(2, "invoke", "read", None), Op(2, "ok", "read", (8, 8)),
+    ]
+    dirty_good = [op.with_(value=(7, 7)) if i == 5 else op
+                  for i, op in enumerate(dirty_bad)]
+    checker = dirty_reads_composed()
+    for hist, expect in ((dirty_bad, False), (dirty_good, True)):
+        res = checker.check(None, None, hist)
+        assert res["valid?"] is expect, res
+        assert res["dirty"]["valid?"] is expect
+        assert res["graph"]["valid?"] is expect

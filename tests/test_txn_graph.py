@@ -1,0 +1,348 @@
+"""The txn serializability checker: edge inference, host/device
+engine parity, Adya classification, counterexample decode, the
+list-append generator + MemDB client, the checker-protocol wrapper,
+merge_valid coercion, adapters, and filetest --txn."""
+
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.checker.checkers import (Serializable, compose,
+                                         merge_valid, UNKNOWN)
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.history import history_to_edn, parse_history
+from comdb2_tpu.ops.synth import (list_append_history,
+                                  txn_anomaly_history)
+from comdb2_tpu.txn import check_txn, infer_edges
+from comdb2_tpu.txn.closure_jax import cyclic_layers_device
+from comdb2_tpu.txn.counterexample import decode, render_text
+from comdb2_tpu.txn.edges import PLANES, TXN_N_FLOOR
+from comdb2_tpu.txn.scc import cyclic_layers_host
+
+
+def _txn(p, mops, typ="ok"):
+    inv = tuple((f, k, None if f == "r" else v) for f, k, v in mops)
+    return [O.invoke(p, "txn", inv), O.Op(p, typ, "txn", tuple(mops))]
+
+
+# --- edge inference ----------------------------------------------------------
+
+def test_edges_ww_wr_rw():
+    h = (_txn(0, [("append", "x", 1)])
+         + _txn(1, [("r", "x", (1,)), ("append", "x", 2)])
+         + _txn(2, [("r", "x", (1, 2))]))
+    g = infer_edges(h)
+    assert g.n == 3
+    ww, wr, rw, rt = (g.adj[i] for i in range(4))
+    assert ww[0, 1] and not ww[1, 0]        # version order x: 1 then 2
+    assert wr[0, 1] and wr[1, 2]            # each read's last element
+    assert not rw.any() and not rt.any()    # reads saw full prefixes
+    assert g.orders["x"] == (1, 2)
+
+
+def test_edges_rw_from_empty_and_stale_reads():
+    h = (_txn(0, [("r", "x", ())])          # missed everything
+         + _txn(1, [("append", "x", 1)])
+         + _txn(2, [("r", "x", (1,))]))
+    g = infer_edges(h)
+    rw = g.adj[PLANES.index("rw")]
+    assert rw[0, 1]                          # empty read -> first writer
+    assert g.adj[PLANES.index("wr")][1, 2]
+
+
+def test_edges_own_append_not_a_dependency():
+    # a txn reading back its own append must not self-depend
+    h = _txn(0, [("append", "x", 1), ("r", "x", (1,))]) \
+        + _txn(1, [("r", "x", (1,))])
+    g = infer_edges(h)
+    assert not g.adj[:, 0, 0].any()
+    assert g.adj[PLANES.index("wr")][0, 1]
+
+
+def test_edges_realtime_optional():
+    h = _txn(0, [("append", "x", 1)]) + _txn(1, [("r", "x", (1,))])
+    assert not infer_edges(h).adj[PLANES.index("rt")].any()
+    g = infer_edges(h, realtime=True)
+    assert g.adj[PLANES.index("rt")][0, 1]
+
+
+def test_failed_txn_excluded_unless_observed():
+    h = _txn(0, [("append", "x", 1)], typ="fail") \
+        + _txn(1, [("r", "x", ())])
+    g = infer_edges(h)
+    assert g.n == 1                          # the fail txn never ran
+    assert not [a for a in g.anomalies if a["name"] == "G1a"]
+    # ... but once OBSERVED it joins the graph and flags G1a
+    h = _txn(0, [("append", "x", 1)], typ="fail") \
+        + _txn(1, [("r", "x", (1,))])
+    g = infer_edges(h)
+    assert g.n == 2 and g.txns[0].dirty
+    assert [a for a in g.anomalies if a["name"] == "G1a"]
+
+
+def test_incompatible_order_flagged():
+    h = (_txn(0, [("append", "x", 1)]) + _txn(1, [("append", "x", 2)])
+         + _txn(2, [("r", "x", (1, 2))]) + _txn(3, [("r", "x", (2, 1))]))
+    r = check_txn(h, backend="host")
+    assert r["valid?"] is False
+    assert any(a["name"] == "incompatible-order" for a in r["anomalies"])
+
+
+def test_padded_bucketing():
+    g = infer_edges(txn_anomaly_history("g2-item"))
+    p = g.padded()
+    assert p.shape == (4, TXN_N_FLOOR, TXN_N_FLOOR)
+    assert p[:, g.n:, :].sum() == 0 and p[:, :, g.n:].sum() == 0
+    with pytest.raises(ValueError):
+        g.padded(2)
+
+
+# --- classification + counterexample -----------------------------------------
+
+@pytest.mark.parametrize("kind,cls", [
+    ("g0", "G0"), ("g1c", "G1c"), ("g2-item", "G2-item")])
+def test_anomaly_classification(kind, cls):
+    for backend in ("host", "device"):
+        r = check_txn(txn_anomaly_history(kind), backend=backend)
+        assert r["valid?"] is False
+        assert r["counterexample"]["class"] == cls, (backend, r)
+
+
+@pytest.mark.parametrize("kind", ["g1a", "duplicate"])
+def test_direct_anomalies(kind):
+    r = check_txn(txn_anomaly_history(kind), backend="host")
+    assert r["valid?"] is False
+    assert any(a["name"].lower().startswith(kind[:4])
+               for a in r["anomalies"])
+
+
+def test_clean_history_valid_both_backends():
+    for backend in ("host", "device"):
+        r = check_txn(txn_anomaly_history("clean"), backend=backend)
+        assert r["valid?"] is True, (backend, r)
+        assert r["counterexample"] is None
+
+
+def test_counterexample_speaks_ops():
+    r = check_txn(txn_anomaly_history("g2-item"), backend="host")
+    cex = r["counterexample"]
+    steps = cex["cycle"]
+    assert len(steps) == 2
+    edge_types = {s["edge"]["type"] for s in steps}
+    assert edge_types == {"rw"}
+    # every step names a real txn's process and micro-ops
+    for s in steps:
+        assert s["status"] == "ok"
+        assert any(m[0] == "append" for m in s["value"])
+    text = render_text(cex)
+    assert "G2-item" in text and "--rw" in text
+
+
+def test_counterexample_svg_renders(tmp_path):
+    from comdb2_tpu.report.txn_svg import render_cycle
+
+    r = check_txn(txn_anomaly_history("g1c"), backend="host")
+    svg = render_cycle(r["counterexample"],
+                       str(tmp_path / "cycle.svg"))
+    assert svg.startswith("<svg") and "G1c" in svg
+    assert (tmp_path / "cycle.svg").exists()
+
+
+# --- engine parity -----------------------------------------------------------
+
+def test_host_device_parity_random_graphs():
+    rng = random.Random(11)
+    for _ in range(20):
+        n = rng.choice([5, 9, 16, 31])
+        adj = np.zeros((4, n, n), dtype=bool)
+        for _e in range(rng.randrange(1, 4 * n)):
+            i, j = rng.randrange(n), rng.randrange(n)
+            if i != j:
+                adj[rng.randrange(4), i, j] = True
+        for rt in (False, True):
+            dh = cyclic_layers_host(adj, realtime=rt)
+            dd = cyclic_layers_device(adj, realtime=rt)
+            assert np.array_equal(dh, dd), (n, rt)
+
+
+def test_parity_on_generated_histories():
+    rng = random.Random(5)
+    for seed in range(5):
+        h = list_append_history(random.Random(seed), n_procs=4,
+                                n_txns=30, n_keys=3,
+                                p_info=0.1, p_fail=0.15)
+        g = infer_edges(h)
+        if not g.adj.any():
+            continue
+        assert np.array_equal(cyclic_layers_host(g.adj),
+                              cyclic_layers_device(g.adj)), seed
+
+
+# --- generator + harness client ----------------------------------------------
+
+def test_generator_serializable_by_construction():
+    for seed in range(10):
+        h = list_append_history(random.Random(seed), n_procs=4,
+                                n_txns=30, n_keys=3,
+                                p_info=0.1, p_fail=0.1)
+        r = check_txn(h, backend="host")
+        assert r["valid?"] is True, (seed, r)
+        # strict serializability holds too: apply points sit inside
+        # op windows, so the serial order extends realtime
+        r = check_txn(h, backend="host", realtime=True)
+        assert r["valid?"] is True, (seed, r)
+
+
+def test_memdb_list_append_harness_run(tmp_path):
+    from comdb2_tpu.harness import core, fake
+    from comdb2_tpu.harness import generator as G
+    from comdb2_tpu.workloads import comdb2 as W
+    from comdb2_tpu.workloads.sqlish import MemDB
+
+    t = fake.noop_test()
+    t.update({
+        "nodes": [], "concurrency": 4, "name": "la-mem",
+        "store-root": str(tmp_path / "store"),
+        "client": W.ListAppendClient(MemDB().connect),
+        "model": None,
+        "generator": G.clients(G.time_limit(1.0, G.stagger(
+            0.005, W.list_append_gen()))),
+        "checker": Serializable(backend="host"),
+    })
+    res = core.run(t)
+    assert res["results"]["valid?"] is True, res["results"]
+    assert res["results"]["txn-count"] >= 20
+
+
+def test_serializable_checker_writes_artifacts(tmp_path):
+    t = {"name": "txn-art", "start-time": "t0",
+         "store-root": str(tmp_path)}
+    res = Serializable(backend="host").check(
+        t, None, txn_anomaly_history("g2-item"))
+    assert res["valid?"] is False
+    base = tmp_path / "txn-art" / "t0"
+    assert (base / "serializable.txt").exists()
+    assert (base / "serializable.svg").exists()
+    assert "G2-item" in (base / "serializable.txt").read_text()
+
+
+# --- verdict-merge machinery -------------------------------------------------
+
+def test_merge_valid_coerces_unrecognized_to_unknown():
+    assert merge_valid([True, "crashed"]) == UNKNOWN
+    assert merge_valid([True, None]) == UNKNOWN
+    # ... but False still dominates everything
+    assert merge_valid([False, "crashed"]) is False
+    assert merge_valid(["crashed", False]) is False
+    assert merge_valid([True, True]) is True
+    assert merge_valid([True, UNKNOWN]) == UNKNOWN
+
+
+def test_compose_with_serializable():
+    both = compose({"graph": Serializable(backend="host")})
+    res = both.check({}, None, txn_anomaly_history("g1c"))
+    assert res["valid?"] is False
+    assert res["graph"]["counterexample"]["class"] == "G1c"
+
+
+# --- adapters (second opinions) ----------------------------------------------
+
+def test_g2_adapter_agrees_with_g2_checker():
+    from comdb2_tpu.checker.workloads import g2_checker
+    from comdb2_tpu.txn.adapters import g2_as_txns
+
+    # the dangerous interleaving: both inserts commit on key 7
+    bad = [
+        O.invoke(0, "insert", (7, (1, None))),
+        O.ok(0, "insert", (7, (1, None))),
+        O.invoke(1, "insert", (7, (None, 2))),
+        O.ok(1, "insert", (7, (None, 2))),
+    ]
+    # the healthy one: the second insert failed validation
+    good = [op.with_(type="fail") if i == 3 else op
+            for i, op in enumerate(bad)]
+    for hist, expect in ((bad, False), (good, True)):
+        adya = g2_checker.check(None, None, hist)["valid?"]
+        graph = check_txn(g2_as_txns(hist), backend="host")["valid?"]
+        assert adya is expect and graph is expect, \
+            (expect, adya, graph)
+    r = check_txn(g2_as_txns(bad), backend="host")
+    assert r["counterexample"]["class"] == "G2-item"
+
+
+def test_dirty_reads_adapter_agrees():
+    from comdb2_tpu.checker.workloads import dirty_reads_checker
+    from comdb2_tpu.txn.adapters import dirty_reads_as_txns
+
+    bad = [
+        O.invoke(0, "write", 7), O.ok(0, "write", 7),
+        O.invoke(1, "write", 8), O.fail(1, "write", 8),
+        O.invoke(2, "read", None), O.ok(2, "read", (8, 8, 8)),
+    ]
+    good = [op.with_(value=(7, 7, 7)) if i == 5 else op
+            for i, op in enumerate(bad)]
+    for hist, expect in ((bad, False), (good, True)):
+        dirty = dirty_reads_checker.check(None, None, hist)["valid?"]
+        graph = check_txn(dirty_reads_as_txns(hist),
+                          backend="host")["valid?"]
+        assert dirty is expect and graph is expect, \
+            (expect, dirty, graph)
+    r = check_txn(dirty_reads_as_txns(bad), backend="host")
+    assert any(a["name"] == "G1a" for a in r["anomalies"])
+
+
+# --- filetest ---------------------------------------------------------------
+
+def test_filetest_txn_round_trip(tmp_path):
+    f = tmp_path / "h.edn"
+    f.write_text(history_to_edn(txn_anomaly_history("g2-item")))
+    r = subprocess.run(
+        [sys.executable, "-m", "comdb2_tpu.filetest", "--txn",
+         "--backend", "host", str(f)],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "G2-item" in r.stdout
+    f.write_text(history_to_edn(txn_anomaly_history("clean")))
+    r = subprocess.run(
+        [sys.executable, "-m", "comdb2_tpu.filetest", "--txn",
+         "--backend", "host", str(f)],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_edn_round_trip_preserves_micro_ops():
+    h = txn_anomaly_history("g1c")
+    back = parse_history(history_to_edn(h))
+    assert check_txn(back, backend="host")["valid?"] is False
+    g1, g2 = infer_edges(h), infer_edges(back)
+    assert np.array_equal(g1.adj, g2.adj)
+
+
+def test_unexpected_value_flagged():
+    """A read observing a value nobody appended is fabricated data,
+    not a clean run (review regression)."""
+    h = _txn(0, [("append", "x", 1)]) + _txn(1, [("r", "x", (1, 5))])
+    r = check_txn(h, backend="host")
+    assert r["valid?"] is False, r
+    assert any(a["name"] == "unexpected-value" and a["values"] == [5]
+               for a in r["anomalies"]), r
+
+
+def test_orphan_completion_unconstrained_in_realtime():
+    """A completion with no invoke (truncated history) must not
+    fabricate rt edges from its own position — its real invoke may
+    have overlapped anything (review regression)."""
+    h = (_txn(0, [("append", "x", 7)])
+         + [O.Op(1, "ok", "txn", (("r", "x", ()),))]   # orphan
+         + _txn(2, [("r", "x", (7,))]))
+    r = check_txn(h, backend="host", realtime=True)
+    assert r["valid?"] is True, r
+    g = infer_edges(h, realtime=True)
+    rt = g.adj[PLANES.index("rt")]
+    orphan = next(i for i, t in enumerate(g.txns) if t.invoke_at < 0)
+    assert not rt[:, orphan].any()      # nothing realtime-precedes it
