@@ -30,6 +30,12 @@ short story per rule id:
   streamed). Pack the items into ONE ``checker.batch.pack_batch`` /
   ``check_batch`` call, or submit them to the ``comdb2_tpu.service``
   verifier daemon, which coalesces callers into shared dispatches.
+- ``per-op-host-loop`` — the pack/segment ingest path is columnar
+  since round 6 (the per-op walk measured ``host_pack_s = 278.2``
+  against ~70 s of device time at the 4096x bench shape); a ``for``
+  loop over ``<x>.ops`` inside those modules reintroduces per-op
+  Python on the hot path. Op objects are API-edge views only
+  (counterexample decode, report rendering — suppression-listed).
 """
 
 from __future__ import annotations
@@ -53,6 +59,14 @@ PARSE_NAMES = {"parse_history", "parse_history_fast"}
 #: txn kind), never a loop of ``closure_diag`` calls.
 PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device",
                            "closure_diag", "cyclic_layers_device"}
+
+#: modules forming the columnar pack/segment ingest path — a per-op
+#: ``for ... in <x>.ops`` loop there is the ``per-op-host-loop``
+#: hazard (files whose basename contains "pack" are included so the
+#: seeded fixture and future pack helpers are covered)
+PACK_SEGMENT_MODULES = {"packed.py", "columnar.py",
+                        "synth_columnar.py", "batch.py",
+                        "linear_jax.py", "pallas_seg.py"}
 
 
 def _name_of(node: ast.AST) -> str:
@@ -91,8 +105,17 @@ class _ModuleInfo(ast.NodeVisitor):
         self.cond_calls: List[ast.Call] = []
         self.func_defs: Dict[str, ast.AST] = {}
         self.loop_dispatch: List[Tuple[int, str]] = []
+        self.ops_loops: List[int] = []
         self._fn_depth = 0
         self._loop_depth = 0
+
+    def _note_ops_iter(self, lineno: int, iter_node) -> None:
+        """Record a loop whose iterated expression reaches a ``.ops``
+        attribute (incl. wrapped forms like ``enumerate(p.ops)``)."""
+        for sub in ast.walk(iter_node):
+            if isinstance(sub, ast.Attribute) and sub.attr == "ops":
+                self.ops_loops.append(lineno)
+                return
 
     # -- imports -------------------------------------------------------
 
@@ -133,6 +156,11 @@ class _ModuleInfo(ast.NodeVisitor):
     visit_AsyncFunctionDef = _visit_fn
 
     def _visit_loop(self, node) -> None:
+        it = getattr(node, "iter", None)        # For / AsyncFor
+        if it is not None:
+            self._note_ops_iter(node.lineno, it)
+        for gen in getattr(node, "generators", ()):  # comprehensions
+            self._note_ops_iter(node.lineno, gen.iter)
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1
@@ -374,6 +402,16 @@ def lint_file(path: str, source: Optional[str] = None) -> List[Finding]:
                 "ops/s); pack the items through checker.batch."
                 "pack_batch/check_batch or submit them to the "
                 "comdb2_tpu.service verifier daemon"))
+
+    if base in PACK_SEGMENT_MODULES or "pack" in base:
+        for ln in info.ops_loops:
+            raw.append(Finding(
+                "per-op-host-loop", path, ln,
+                "for-loop over .ops inside the pack/segment ingest "
+                "path — the packer is columnar (per-op Python "
+                "measured host_pack_s=278.2 vs ~70 s device at the "
+                "4096x shape); keep Op objects an API-edge view and "
+                "work on the struct-of-arrays columns"))
 
     if "nemesis" in base:
         for ln, val in info.nemesis_bad_type:

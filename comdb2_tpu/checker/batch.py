@@ -48,25 +48,25 @@ def _malformed(p: PackedHistory) -> bool:
     exceptions the same way (``checker.clj:54-64`` check-safe; the
     analog raise lives in ``make_segments``).
 
-    Vectorized: group invoke/completion events per process (stable
-    sort); two adjacent invokes within one process's subsequence mean
-    a double-pending invocation. Cached per PackedHistory — check_batch
+    Vectorized via the shared per-process chain machinery
+    (``ops.columnar._per_process_prev``): a non-failing invoke whose
+    previous same-process event is also a non-failing invoke is a
+    double-pending invocation. Cached per PackedHistory — check_batch
     and its segment helpers each consult it."""
+    from ..ops.columnar import _per_process_prev
+
     cached = getattr(p, "_malformed_cache", None)
     if cached is not None:
         return cached
     t = np.asarray(p.type)
     inv = (t == INVOKE) & ~np.asarray(p.fails)
-    sel = inv | (t == OK) | (t == FAIL)
-    if not sel.any():
+    sel = np.flatnonzero(inv | (t == OK) | (t == FAIL))
+    if not sel.size:
         out = False
     else:
-        procs = np.asarray(p.process)[sel]
-        isinv = inv[sel]
-        order = np.argsort(procs, kind="stable")
-        ps, iv = procs[order], isinv[order]
-        same = ps[1:] == ps[:-1]
-        out = bool(np.any(same & iv[1:] & iv[:-1]))
+        _, inv_flag, prev_inv, _ = _per_process_prev(
+            np.asarray(p.process), sel, inv)
+        out = bool(np.any(inv_flag & prev_inv))
     try:
         p._malformed_cache = out
     except AttributeError:
@@ -223,11 +223,70 @@ def segment_batch(batch: PackedBatch,
     )
 
 
+def _build_streams(batch: PackedBatch, indices, s_pad: int = 0,
+                   k_pad: int = 0):
+    """Union-remapped, slot-renamed SegmentStreams for a SUBSET of the
+    batch — the unit of the pipelined dispatch (``_stream_stage``
+    builds slice i+1 on the host while the device runs slice i).
+    Returns ``(streams, p_eff)``; slot renaming runs the batched
+    :func:`~.linear_jax.remap_slots_batch` over every history without
+    an admission-time cache (``COMDB2_TPU_LEGACY_PACK=1`` routes
+    through per-history ``remap_slots``)."""
+    from ..ops.packed import legacy_pack_enabled
+
+    indices = list(indices)
+    out: list = [None] * len(indices)
+    p_eff = 1
+    need: list = []
+    raw: list = []
+    for j, i in enumerate(indices):
+        p = batch.packeds[i]
+        malformed = _malformed(p)
+        s = (_empty_stream() if malformed
+             else _segments_of(p, s_pad=s_pad, k_pad=k_pad))
+        remap = np.asarray(batch.remaps[i], np.int32)
+        if remap.size:
+            inv_tr = np.where(s.inv_proc >= 0, remap[s.inv_tr],
+                              0).astype(np.int32)
+        else:  # no successful invokes anywhere: nothing to remap
+            inv_tr = np.zeros_like(s.inv_tr, np.int32)
+        cached_remap = (None if malformed
+                        else getattr(p, "_remap_cache", None))
+        if cached_remap is not None:
+            # slot renaming depends on (inv_proc, ok_proc) only, so an
+            # admission-time pass (bucket_for) is reusable verbatim —
+            # just pad its exact-shape proc arrays to this stream's
+            rproc, rok, pe = cached_remap
+            ds = s.ok_proc.shape[0] - rok.shape[0]
+            dk = s.inv_proc.shape[1] - rproc.shape[1]
+            out[j] = LJ.SegmentStream(
+                np.pad(rproc, ((0, ds), (0, dk)), constant_values=-1),
+                inv_tr,
+                np.pad(rok, (0, ds), constant_values=-1),
+                s.seg_index, s.depth)
+            p_eff = max(p_eff, pe)
+        else:
+            need.append(j)
+            raw.append(LJ.SegmentStream(
+                s.inv_proc, inv_tr, s.ok_proc, s.seg_index, s.depth))
+    if need:
+        if legacy_pack_enabled():
+            renamed = [LJ.remap_slots(r) for r in raw]
+            streams2 = [r[0] for r in renamed]
+            pes = [r[1] for r in renamed]
+        else:
+            streams2, pes = LJ.remap_slots_batch(raw)
+        for j, s2, pe in zip(need, streams2, pes):
+            out[j] = s2
+            p_eff = max(p_eff, pe)
+    return out, p_eff
+
+
 def _stream_segments(batch: PackedBatch, s_pad: int = 0,
                      k_pad: int = 0):
     """Per-history SegmentStreams with transition ids remapped into the
     union table (the streamed kernel shares ONE table) and process ids
-    renamed to minimal reusable slots (:func:`~.linear_jax.remap_slots`
+    renamed to minimal reusable slots (:func:`~.linear_jax.remap_slots_batch`
     — the kernel's slot axis then scales with each history's max
     concurrent open calls, not its process count). Malformed histories
     get an empty stream; ``check_batch`` reports them ``unknown``.
@@ -243,38 +302,122 @@ def _stream_segments(batch: PackedBatch, s_pad: int = 0,
     cached = getattr(batch, "_stream_seg_cache", None)
     if cached is not None and cached[0] == (s_pad, k_pad):
         return cached[1]
-    out = []
-    p_eff = 1
-    for i, p in enumerate(batch.packeds):
-        s = (_empty_stream() if _malformed(p)
-             else _segments_of(p, s_pad=s_pad, k_pad=k_pad))
-        remap = np.asarray(batch.remaps[i], np.int32)
-        if remap.size:
-            inv_tr = np.where(s.inv_proc >= 0, remap[s.inv_tr],
-                              0).astype(np.int32)
-        else:  # no successful invokes anywhere: nothing to remap
-            inv_tr = np.zeros_like(s.inv_tr, np.int32)
-        cached_remap = (None if _malformed(p)
-                        else getattr(p, "_remap_cache", None))
-        if cached_remap is not None:
-            # slot renaming depends on (inv_proc, ok_proc) only, so an
-            # admission-time pass (bucket_for) is reusable verbatim —
-            # just pad its exact-shape proc arrays to this stream's
-            rproc, rok, pe = cached_remap
-            ds = s.ok_proc.shape[0] - rok.shape[0]
-            dk = s.inv_proc.shape[1] - rproc.shape[1]
-            s2 = LJ.SegmentStream(
-                np.pad(rproc, ((0, ds), (0, dk)), constant_values=-1),
-                inv_tr,
-                np.pad(rok, (0, ds), constant_values=-1),
-                s.seg_index, s.depth)
-        else:
-            s2, pe = LJ.remap_slots(LJ.SegmentStream(
-                s.inv_proc, inv_tr, s.ok_proc, s.seg_index, s.depth))
-        p_eff = max(p_eff, pe)
-        out.append(s2)
+    out, p_eff = _build_streams(batch, range(len(batch.packeds)),
+                                s_pad=s_pad, k_pad=k_pad)
     batch._stream_seg_cache = ((s_pad, k_pad), (out, p_eff))
     return out, p_eff
+
+
+#: histories per pipelined dispatch slice: small enough that slice
+#: i+1's host pack overlaps slice i's device run on big batches, big
+#: enough to amortize per-dispatch overhead (the 4096x bench packs 8
+#: slices; a service-sized batch stays one slice and overlaps across
+#: BUCKETS via the tick loop's double buffer instead)
+PIPELINE_B = 512
+
+
+def _kernel_P(p_eff: int) -> int:
+    """The slot width the streamed kernel compiles for: even-bucketed
+    (halves the spec space; matches ``linear._analyze_device``) while
+    the fast (8,128) tier still serves it — P_eff 7 must NOT round to
+    8 and fall off the ~45%-slower (16,128) tier."""
+    p2 = max(p_eff, 1)
+    p2 += p2 & 1
+    return p2 if p2 <= PSEG.ROWS - 1 else max(p_eff, 1)
+
+
+def _slice_spec(streams, sizes, p_eff_pad):
+    """Kernel spec for ONE dispatch slice, derived from the renamed
+    streams themselves (every allocated slot appears in the arrays, so
+    max slot id + 1 IS the slice's effective P). Both the cold
+    pipelined pass and the cached rerun derive specs through this one
+    function — same slices, same streams, same compiled programs, so
+    a warm rerun never triggers a fresh Mosaic compile."""
+    pe, K = 0, 1
+    for s in streams:
+        K = max(K, s.inv_proc.shape[1])
+        if s.inv_proc.size:
+            pe = max(pe, int(s.inv_proc.max()) + 1)
+        if s.ok_proc.size:
+            pe = max(pe, int(s.ok_proc.max()) + 1)
+    P = _kernel_P(max(pe, p_eff_pad))
+    return PSEG.spec_for(sizes["n_states"], sizes["n_transitions"],
+                         P, K + (K & 1))
+
+
+def _stream_stage(batch: PackedBatch, succ, sizes, s_pad, k_pad,
+                  p_eff_pad, mesh):
+    """Stage the streamed-kernel dispatches WITHOUT blocking on
+    results. On a cold batch the host segment/remap/pack pass runs
+    slice-by-slice, dispatching each slice before building the next —
+    JAX dispatch is async, so slice i's device run overlaps slice
+    i+1's host pack (double-buffered staging; this container has one
+    CPU, the overlap is host-compute vs device-compute). On a batch
+    with cached streams (timed bench reruns, capacity escalation) the
+    slices dispatch back-to-back from the cache.
+
+    Returns ``(pending, segs_list)``: ``pending`` is a list of
+    ``((res, starts), start, end)`` handles for
+    :func:`_stream_collect`, or None when the shape can't run fused —
+    ``segs_list`` is still complete then, so the XLA engines reuse the
+    streams (`segment_batch(streams=...)`)."""
+    devices = (list(mesh.devices.flat) if mesh is not None else None)
+    ndev = len(devices) if devices else 0
+    devs = devices if devices else [None]
+    cached = getattr(batch, "_stream_seg_cache", None)
+    cached = cached[1] if cached is not None \
+        and cached[0] == (s_pad, k_pad) else None
+    B = len(batch)
+    plan = PSEG.plan_stream_slices(
+        B, ndev, max_stream_b=min(PSEG.MAX_STREAM_B, PIPELINE_B))
+    pending: list = []
+    if cached is not None:
+        segs_list, _ = cached
+        for start, end, dix in plan:
+            spec = _slice_spec(segs_list[start:end], sizes, p_eff_pad)
+            if spec is None:
+                return None, segs_list
+            pending.append((PSEG.stream_dispatch(
+                succ, segs_list[start:end], spec, sizes["n_states"],
+                sizes["n_transitions"],
+                devs[dix] if ndev else None), start, end))
+        return pending, segs_list
+    all_streams: list = []
+    p_eff_all = 1
+    dead = False
+    for start, end, dix in plan:
+        streams, pe = _build_streams(batch, range(start, end),
+                                     s_pad=s_pad, k_pad=k_pad)
+        all_streams.extend(streams)
+        p_eff_all = max(p_eff_all, pe)
+        if dead:
+            continue            # finish building the cacheable streams
+        spec = _slice_spec(streams, sizes, p_eff_pad)
+        if spec is None:
+            dead = True
+            pending = []
+            continue
+        pending.append((PSEG.stream_dispatch(
+            succ, streams, spec, sizes["n_states"],
+            sizes["n_transitions"], devs[dix] if ndev else None),
+            start, end))
+    batch._stream_seg_cache = ((s_pad, k_pad),
+                               (all_streams, p_eff_all))
+    if dead:
+        return None, all_streams
+    return pending, all_streams
+
+
+def _stream_collect(pending, B):
+    """Block on the staged dispatches in order and merge the
+    per-slice verdicts (each ``np.asarray`` waits on that slice's
+    device only)."""
+    rs: list = [None] * B
+    for (res, starts), start, end in pending:
+        res = np.asarray(res)
+        rs[start:end] = PSEG.merge_stream_slice(res, starts,
+                                                end - start)
+    return rs
 
 
 def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
@@ -282,7 +425,7 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
                 info: Optional[dict] = None, s_pad: int = 0,
                 k_pad: int = 0, n_states_pad: int = 0,
                 n_transitions_pad: int = 0, p_eff_pad: int = 0):
-    """Run the batched device search (see :func:`_check_batch_impl`);
+    """Run the batched device search (see :func:`check_batch_async`);
     malformed histories (double-pending process) come back ``unknown``
     instead of poisoning the batch or diverging between engines.
 
@@ -293,46 +436,71 @@ def check_batch(batch: PackedBatch, F: int = 256, mesh=None,
     Oversizing is sound: states/transitions are ids below the real
     counts, ``pad_succ`` widens the table to match, and padding
     segments are no-ops to every engine."""
-    status, fail_at, n_final = _check_batch_impl(
+    return check_batch_async(
         batch, F=F, mesh=mesh, batch_axis=batch_axis, engine=engine,
         info=info, s_pad=s_pad, k_pad=k_pad,
         n_states_pad=n_states_pad,
-        n_transitions_pad=n_transitions_pad, p_eff_pad=p_eff_pad)
-    bad = [i for i, p in enumerate(batch.packeds) if _malformed(p)]
-    if bad:
-        status = np.array(status, np.int32)
-        fail_at = np.array(fail_at, np.int64)
-        n_final = np.array(n_final, np.int32)
-        status[bad] = LJ.UNKNOWN
-        fail_at[bad] = -1
-        n_final[bad] = 0
-    return status, fail_at, n_final
+        n_transitions_pad=n_transitions_pad, p_eff_pad=p_eff_pad)()
 
 
-def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
+def check_batch_async(batch: PackedBatch, F: int = 256, mesh=None,
                       batch_axis: str = "batch", engine: str = "auto",
                       info: Optional[dict] = None, s_pad: int = 0,
                       k_pad: int = 0, n_states_pad: int = 0,
                       n_transitions_pad: int = 0, p_eff_pad: int = 0):
-    """Run the batched device search; returns (status[N], fail_at[N],
-    n_final[N]) NumPy arrays — fail_at in history-index terms. With
-    ``mesh``, the batch axis is sharded across devices (data
-    parallelism over ICI): the streamed kernel spreads history slices
-    across the mesh's devices, the keys/flat engines run shard_mapped
-    with each device checking its own B/D sub-batch, and only the
-    vmap fallback uses plain sharding annotations.
+    """Stage the batched device search and return a zero-argument
+    ``finalize()`` producing ``(status[N], fail_at[N], n_final[N])``
+    NumPy arrays — fail_at in history-index terms.
+
+    Between stage and finalize the DEVICE work proceeds asynchronously
+    (JAX dispatch is async; only the finalize readback blocks), so a
+    caller can pack the NEXT batch's host tensors while this one runs
+    — the service tick loop double-buffers exactly this way. The big-
+    batch stream path additionally pipelines within one call: the host
+    segments/packs dispatch slice i+1 while the device runs slice i.
 
     engine: "stream" runs all histories through the fused Pallas
-    kernel as one streamed scan (fastest on TPU — measured ~6x the
-    keys engine); "keys" keeps the frontier as packed int32 key pairs
-    — config mutation is bit arithmetic, dedup one sort; "flat" folds
-    all frontiers into one explicit tensor with the batch id as the
-    top sort key; "vmap" is the per-lane fallback; "auto" picks the
-    best available whose budget fits.
+    kernel as a sliced sequence of streamed scans (fastest on TPU —
+    measured ~6x the keys engine); "keys" keeps the frontier as packed
+    int32 key pairs — config mutation is bit arithmetic, dedup one
+    sort; "flat" folds all frontiers into one explicit tensor with the
+    batch id as the top sort key; "vmap" is the per-lane fallback;
+    "auto" picks the best available whose budget fits.
 
     info: optional dict — receives {"engine": name} for the path
-    actually executed (observability; tests and bench assert on it).
+    actually executed (observability; tests and bench assert on it);
+    populated at stage time.
     """
+    fin = _check_batch_begin(
+        batch, F=F, mesh=mesh, batch_axis=batch_axis, engine=engine,
+        info=info, s_pad=s_pad, k_pad=k_pad,
+        n_states_pad=n_states_pad,
+        n_transitions_pad=n_transitions_pad, p_eff_pad=p_eff_pad)
+
+    def finalize():
+        status, fail_at, n_final = fin()
+        bad = [i for i, p in enumerate(batch.packeds)
+               if _malformed(p)]
+        if bad:
+            status = np.array(status, np.int32)
+            fail_at = np.array(fail_at, np.int64)
+            n_final = np.array(n_final, np.int32)
+            status[bad] = LJ.UNKNOWN
+            fail_at[bad] = -1
+            n_final[bad] = 0
+        return status, fail_at, n_final
+
+    return finalize
+
+
+def _check_batch_begin(batch: PackedBatch, F: int, mesh,
+                       batch_axis: str, engine: str,
+                       info: Optional[dict], s_pad: int, k_pad: int,
+                       n_states_pad: int, n_transitions_pad: int,
+                       p_eff_pad: int):
+    """Engine selection + host packing + async device dispatch;
+    returns the finalize closure (readback, fail-index decode, kernel
+    overflow escalation)."""
     # declared table sizes may be floored (bucketed) above the real
     # counts: ids stay below the real counts, so widening the fields
     # and the padded table is a pure relabeling of the key layout
@@ -380,74 +548,74 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
         engine = "stream" if stream_fits() else pick_xla_engine()
     prebuilt_streams = None      # reused by keys/flat when the kernel
     if engine == "stream":       # path rejects an already-built batch
-        rs = None
+        pending = None
         if stream_fits():
-            segs_list, P_stream = _stream_segments(batch, s_pad=s_pad,
-                                                   k_pad=k_pad)
-            # flooring the slot count pins the kernel SPEC too — a
-            # serving layer bucketing by effective concurrency then
-            # compiles one kernel per bucket, not one per batch's
-            # incidental max (extra slots just sit idle)
-            P_stream = max(P_stream, p_eff_pad)
-            prebuilt_streams = segs_list
-            devices = (list(mesh.devices.flat)
-                       if mesh is not None else None)
             # the padded succ, not the raw memo table: the kernel's
             # flat-table stride is the declared n_transitions, which
-            # may be floored above the real column count
-            rs = PSEG.check_device_pallas_stream(
-                succ, segs_list, P=P_stream,
-                devices=devices, **sizes)
-        if rs is not None:
+            # may be floored above the real column count; p_eff_pad
+            # floors the slot count so a serving layer bucketing by
+            # effective concurrency compiles one kernel per bucket
+            pending, segs_list = _stream_stage(
+                batch, succ, sizes, s_pad, k_pad, p_eff_pad, mesh)
+            prebuilt_streams = segs_list
+        if pending is not None:
             note("stream" if mesh is None else "stream-sharded")
-            status = np.array([r[0] for r in rs], np.int32)
-            fail_at = np.array([
-                segs_list[b].seg_index[rs[b][1]] if rs[b][1] >= 0
-                else -1 for b in range(B)], np.int64)
-            n_final = np.array([r[2] for r in rs], np.int32)
-            # the kernel's frontier is fixed at 128: histories that
-            # overflowed it get their requested budget F through the
-            # XLA engines instead of surfacing spurious UNKNOWNs
-            unk = escalation_indices(status, F, PSEG.F)
-            # the sub-batch is sized by the overflow count, so pick
-            # the escalation engine from THAT size — at pod-scale
-            # batches the full-B budgets never fit while a handful of
-            # overflowed histories easily do
-            sub_b = (-(-int(unk.size) // D) if D > 1
-                     else int(unk.size))
-            esc_engine = pick_xla_engine(max(sub_b, 1))
-            if unk.size and batch.kind.shape[1] == 0 \
-                    and esc_engine == "vmap":
-                # packed with build_streams=False and only the vmap
-                # path could take the overflow: those histories must
-                # stay unknown — record that escalation was REQUESTED
-                # but impossible so callers can tell this apart from
-                # "no overflow" (ADVICE r4)
-                if info is not None:
-                    info["escalated"] = {"engine": None,
-                                         "count": int(unk.size)}
-                unk = np.empty(0, np.int64)
-            if unk.size:
-                sub = PackedBatch(
-                    packeds=[batch.packeds[i] for i in unk],
-                    memo=batch.memo,
-                    kind=batch.kind[unk], proc=batch.proc[unk],
-                    tr=batch.tr[unk], P=batch.P,
-                    remaps=[batch.remaps[i] for i in unk])
-                sub_info: dict = {}
-                st2, fa2, n2 = check_batch(
-                    sub, F=F, mesh=mesh, engine=esc_engine,
-                    info=sub_info, s_pad=s_pad, k_pad=k_pad,
-                    n_states_pad=n_states_pad,
-                    n_transitions_pad=n_transitions_pad,
-                    p_eff_pad=p_eff_pad)
-                status, fail_at, n_final = merge_escalation(
-                    status, fail_at, n_final, unk, st2, fa2, n2)
-                if info is not None:    # the label must not claim the
-                    info["escalated"] = {  # kernel checked everything
-                        "engine": sub_info.get("engine"),
-                        "count": int(unk.size)}
-            return status, fail_at, n_final
+
+            def finalize_stream():
+                rs = _stream_collect(pending, B)
+                status = np.array([r[0] for r in rs], np.int32)
+                fail_at = np.array([
+                    segs_list[b].seg_index[rs[b][1]] if rs[b][1] >= 0
+                    else -1 for b in range(B)], np.int64)
+                n_final = np.array([r[2] for r in rs], np.int32)
+                # the kernel's frontier is fixed at 128: histories
+                # that overflowed it get their requested budget F
+                # through the XLA engines instead of surfacing
+                # spurious UNKNOWNs
+                unk = escalation_indices(status, F, PSEG.F)
+                # the sub-batch is sized by the overflow count, so
+                # pick the escalation engine from THAT size — at
+                # pod-scale batches the full-B budgets never fit while
+                # a handful of overflowed histories easily do
+                sub_b = (-(-int(unk.size) // D) if D > 1
+                         else int(unk.size))
+                esc_engine = pick_xla_engine(max(sub_b, 1))
+                if unk.size and batch.kind.shape[1] == 0 \
+                        and esc_engine == "vmap":
+                    # packed with build_streams=False and only the
+                    # vmap path could take the overflow: those
+                    # histories must stay unknown — record that
+                    # escalation was REQUESTED but impossible so
+                    # callers can tell this apart from "no overflow"
+                    # (ADVICE r4)
+                    if info is not None:
+                        info["escalated"] = {"engine": None,
+                                             "count": int(unk.size)}
+                    unk = np.empty(0, np.int64)
+                if unk.size:
+                    sub = PackedBatch(
+                        packeds=[batch.packeds[i] for i in unk],
+                        memo=batch.memo,
+                        kind=batch.kind[unk], proc=batch.proc[unk],
+                        tr=batch.tr[unk], P=batch.P,
+                        remaps=[batch.remaps[i] for i in unk])
+                    sub_info: dict = {}
+                    st2, fa2, n2 = check_batch(
+                        sub, F=F, mesh=mesh, engine=esc_engine,
+                        info=sub_info, s_pad=s_pad, k_pad=k_pad,
+                        n_states_pad=n_states_pad,
+                        n_transitions_pad=n_transitions_pad,
+                        p_eff_pad=p_eff_pad)
+                    status2, fail_at2, n_final2 = merge_escalation(
+                        status, fail_at, n_final, unk, st2, fa2, n2)
+                    if info is not None:  # the label must not claim
+                        info["escalated"] = {   # the kernel checked
+                            "engine": sub_info.get("engine"),  # all
+                            "count": int(unk.size)}
+                    return status2, fail_at2, n_final2
+                return status, fail_at, n_final
+
+            return finalize_stream
         engine = pick_xla_engine()
     if engine in ("keys", "flat"):
         note(engine if mesh is None else engine + "-sharded")
@@ -455,33 +623,39 @@ def _check_batch_impl(batch: PackedBatch, F: int = 256, mesh=None,
                            s_pad=s_pad, k_pad=k_pad)
         if mesh is not None:
             ip, it, op_, dp = _pad_batch_axis(sb, B_pad - B)
-            status, fail_seg, n_final = LJ.check_device_keys_sharded(
-                mesh, succ, ip, it, op_, dp, B=B_pad, F=F, P=P,
-                batch_axis=batch_axis, engine=engine, **sizes)
+            status_d, fail_seg_d, n_final_d = \
+                LJ.check_device_keys_sharded(
+                    mesh, succ, ip, it, op_, dp, B=B_pad, F=F, P=P,
+                    batch_axis=batch_axis, engine=engine, **sizes)
         else:
             fn = (LJ.check_device_keys if engine == "keys"
                   else LJ.check_device_flat)
-            status, fail_seg, n_final = fn(
+            status_d, fail_seg_d, n_final_d = fn(
                 succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
                 B=B, F=F, P=P, **sizes)
-        status = np.asarray(status)[:B]
-        fail_seg = np.asarray(fail_seg)[:B]
-        fail_at = np.array([
-            sb.seg_index[b, fail_seg[b]] if fail_seg[b] >= 0 else -1
-            for b in range(B)], np.int64)
-        return status, fail_at, np.asarray(n_final)[:B]
+
+        def finalize_xla():
+            status = np.asarray(status_d)[:B]
+            fail_seg = np.asarray(fail_seg_d)[:B]
+            fail_at = np.array([
+                sb.seg_index[b, fail_seg[b]] if fail_seg[b] >= 0
+                else -1 for b in range(B)], np.int64)
+            return status, fail_at, np.asarray(n_final_d)[:B]
+
+        return finalize_xla
     if batch.kind.shape[1] == 0:
         raise ValueError(
             "batch was packed with build_streams=False; the vmap path "
             "needs the dense step streams")
     note("vmap" if mesh is None else "vmap-sharded")
     if mesh is not None:
-        out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc, batch.tr,
-                               F=F, P=P, batch_axis=batch_axis, **sizes)
+        out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc,
+                               batch.tr, F=F, P=P,
+                               batch_axis=batch_axis, **sizes)
     else:
-        out = LJ.check_device_batch(succ, batch.kind, batch.proc, batch.tr,
-                                    F=F, P=P, **sizes)
-    return tuple(np.asarray(x) for x in out)
+        out = LJ.check_device_batch(succ, batch.kind, batch.proc,
+                                    batch.tr, F=F, P=P, **sizes)
+    return lambda: tuple(np.asarray(x) for x in out)
 
 
 def escalation_indices(status: np.ndarray, F: int,

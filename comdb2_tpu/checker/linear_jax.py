@@ -410,7 +410,78 @@ def make_segments(packed, s_pad: Optional[int] = None,
     each run of invokes into its following ok yields one device step
     per ok-op (~3x fewer sequential steps). Invokes after the final ok
     are dropped: a pending call can only *add* linearization orders,
-    never empty a non-empty frontier."""
+    never empty a non-empty frontier.
+
+    Columnar since the host-ingest rebuild (one stable argsort for the
+    per-process pending-discipline check, cumsums for depths, one
+    scatter for the (S, K) fill — bit-identical to the per-op walk,
+    which survives one release behind ``COMDB2_TPU_LEGACY_PACK=1``)."""
+    from ..ops.packed import legacy_pack_enabled
+
+    if legacy_pack_enabled():
+        return make_segments_legacy(packed, s_pad=s_pad, k_pad=k_pad)
+    from ..ops.op import INVOKE, OK, FAIL
+
+    from ..ops.columnar import _per_process_prev
+
+    t = np.asarray(packed.type)
+    proc = np.asarray(packed.process)
+    tra = np.asarray(packed.trans)
+    fl = np.asarray(packed.fails)
+    n = t.shape[0]
+    vinv = (t == INVOKE) & ~fl
+    okm = t == OK
+    failm = t == FAIL
+    removal = np.zeros(n, bool)
+    sel = np.flatnonzero(vinv | okm | failm)
+    if sel.size:
+        # per-process event chains: pending_p is {0,1} (add on a
+        # non-failing invoke, clear on ok/fail), so "p was pending" ==
+        # "p's previous selected event was a non-failing invoke"
+        srt, vflag, prev_v, _ = _per_process_prev(proc, sel, vinv)
+        dbl = vflag & prev_v
+        if dbl.any():
+            i = int(srt[dbl].min())
+            raise ValueError(
+                f"process {int(proc[i])} invokes at row {i} while an "
+                "earlier invocation is still pending — malformed "
+                "history")
+        removal[srt[~vflag & prev_v]] = True
+    ok_idx = np.flatnonzero(okm)
+    S = ok_idx.size
+    cum_rem = np.cumsum(removal)
+    depth_vals = (np.cumsum(vinv)[ok_idx]
+                  - (cum_rem[ok_idx] - removal[ok_idx]))
+    cum_ok_excl = np.cumsum(okm) - okm
+    inv_rows = np.flatnonzero(vinv)
+    seg_of = cum_ok_excl[inv_rows]
+    keep = seg_of < S              # invokes after the final ok drop
+    inv_rows, seg_of = inv_rows[keep], seg_of[keep]
+    if inv_rows.size:
+        kpos = (np.arange(inv_rows.size)
+                - np.searchsorted(seg_of, seg_of, side="left"))
+        K = int(np.bincount(seg_of).max()) or 1
+    else:
+        kpos = seg_of
+        K = 1
+    k_pad = max(k_pad or 0, K)
+    s_pad = max(s_pad or 0, S)
+    inv_proc = np.full((s_pad, k_pad), -1, np.int32)
+    inv_tr = np.zeros((s_pad, k_pad), np.int32)
+    inv_proc[seg_of, kpos] = proc[inv_rows]
+    inv_tr[seg_of, kpos] = tra[inv_rows]
+    ok_proc = np.full(s_pad, -1, np.int32)   # -1 = padding segment
+    seg_index = np.zeros(s_pad, np.int64)
+    depth = np.zeros(s_pad, np.int32)
+    ok_proc[:S] = proc[ok_idx]
+    seg_index[:S] = ok_idx
+    depth[:S] = depth_vals
+    return SegmentStream(inv_proc, inv_tr, ok_proc, seg_index, depth)
+
+
+def make_segments_legacy(packed, s_pad: Optional[int] = None,
+                         k_pad: Optional[int] = None) -> SegmentStream:
+    """The original per-op segment walk (see :func:`make_segments`)."""
     from ..ops.op import INVOKE, OK, FAIL
     n = len(packed)
     segs: list = []
@@ -561,6 +632,96 @@ def remap_slots(segs: SegmentStream, with_maps: bool = False):
                 pos[s, :len(row)] = row
         return segs2, P_eff, pos
     return segs2, P_eff
+
+
+def remap_slots_batch(streams):
+    """Batched :func:`remap_slots` over many SegmentStreams at once —
+    the batch ingest path's form (``checker.batch._stream_segments``).
+    Returns ``(streams', p_effs)`` with outputs BIT-IDENTICAL to
+    per-history ``remap_slots`` (golden parity tests).
+
+    The per-history pass is inherently sequential (lowest-free-first
+    allocation with out-of-order release), but every history advances
+    its segment clock independently — so the loop runs over SEGMENT
+    POSITIONS with all histories as one vector lane each: state is a
+    (B, n_procs) slot map plus a (B, P) in-use mask, and each step is
+    a handful of numpy ops instead of B iterations of Python. The
+    lowest-free rule maps onto ``argmax(~used)`` exactly: slots are
+    allocated contiguously, so the smallest unused index is min(free
+    heap) when the heap is non-empty and the fresh index otherwise."""
+    B = len(streams)
+    if B == 0:
+        return [], []
+    S_max = max(s.ok_proc.shape[0] for s in streams)
+    K_max = max(s.inv_proc.shape[1] for s in streams)
+    if S_max == 0 or all(int(s.ok_proc.shape[0]) == 0 for s in streams):
+        return list(streams), [0] * B
+    ip = np.full((B, S_max, K_max), -1, np.int32)
+    okp = np.full((B, S_max), -1, np.int32)
+    for b, s in enumerate(streams):
+        sb, kb = s.inv_proc.shape
+        ip[b, :sb, :kb] = s.inv_proc
+        okp[b, :sb] = s.ok_proc
+    npc = int(max(ip.max(initial=-1), okp.max(initial=-1), 0)) + 1
+    slot_of = np.full((B, max(npc, 1)), -1, np.int32)
+    # conservative live-slot bound (every ok treated as a release);
+    # unmatched-ok edge allocations can exceed it — grown on demand
+    opens = np.cumsum((ip >= 0).sum(axis=2), axis=1)
+    rel = np.cumsum(okp >= 0, axis=1)
+    p_cap = int(max((opens[:, 1:] - rel[:, :-1]).max(initial=0),
+                    opens[:, 0].max(initial=0), 1)) + 1
+    used = np.zeros((B, p_cap), bool)
+    n_slots = np.zeros(B, np.int32)
+    out_ip = ip.copy()
+    out_ok = okp.copy()
+    bidx = np.arange(B)
+    for s in range(S_max):
+        for k in range(K_max):
+            p = ip[:, s, k]
+            m = p >= 0
+            if not m.any():
+                continue
+            pc = np.where(m, p, 0)
+            if np.any(m & (slot_of[bidx, pc] >= 0)):
+                b = int(np.flatnonzero(m & (slot_of[bidx, pc] >= 0))[0])
+                raise ValueError(
+                    f"process {int(p[b])} invokes in segment {s} while "
+                    "an earlier invocation is still open")
+            while np.any(m & used.all(axis=1)):
+                used = np.pad(used, ((0, 0), (0, used.shape[1])))
+            sl = np.argmax(~used, axis=1).astype(np.int32)
+            out_ip[m, s, k] = sl[m]
+            used[bidx[m], sl[m]] = True
+            slot_of[bidx[m], pc[m]] = sl[m]
+            n_slots = np.maximum(n_slots, np.where(m, sl + 1, 0))
+        o = okp[:, s]
+        m = o >= 0
+        if not m.any():
+            continue
+        oc = np.where(m, o, 0)
+        sl = slot_of[bidx, oc]
+        matched = m & (sl >= 0)
+        out_ok[matched, s] = sl[matched]
+        used[bidx[matched], sl[matched]] = False
+        slot_of[bidx[matched], oc[matched]] = -1
+        un = m & ~matched
+        if un.any():
+            # ok with no open invocation: any free slot is IDLE in
+            # every config — reference one (fresh if none), leaving it
+            # free, exactly like the per-history path
+            while np.any(un & used.all(axis=1)):
+                used = np.pad(used, ((0, 0), (0, used.shape[1])))
+            fs = np.argmax(~used, axis=1).astype(np.int32)
+            out_ok[un, s] = fs[un]
+            n_slots = np.maximum(n_slots, np.where(un, fs + 1, 0))
+    out = []
+    for b, s in enumerate(streams):
+        sb, kb = s.inv_proc.shape
+        out.append(SegmentStream(
+            np.ascontiguousarray(out_ip[b, :sb, :kb]), s.inv_tr,
+            np.ascontiguousarray(out_ok[b, :sb]),
+            s.seg_index, s.depth))
+    return out, [int(x) for x in n_slots]
 
 
 def _make_seg_step(succ, F, P, K, bits, Fs=None):

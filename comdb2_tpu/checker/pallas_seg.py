@@ -910,7 +910,7 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
     import jax.numpy as jnp
 
     K = max((s.inv_proc.shape[1] for s in segs_list), default=1)
-    spec = spec_for(n_states, n_transitions, P, K)
+    spec = spec_for(n_states, n_transitions, P, K + (K & 1))
     if spec is None:
         return None
     B = len(segs_list)
@@ -927,9 +927,9 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
     pending = []
     for start, end, dev_ix in plan:
         dev = devs[dev_ix] if devs[0] is not None else None
-        pending.append(_stream_dispatch(succ, segs_list[start:end],
-                                        spec, n_states, n_transitions,
-                                        dev))
+        pending.append(stream_dispatch(succ, segs_list[start:end],
+                                       spec, n_states, n_transitions,
+                                       dev))
     out = []
     for (res, starts), (start, end, _) in zip(pending, plan):
         res = np.asarray(res)       # blocks on THIS slice's device only
@@ -966,10 +966,13 @@ def merge_stream_slice(res: np.ndarray, starts, n: int):
     return out
 
 
-def _stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
-                     device=None):
+def stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
+                    device=None):
     """Dispatch one streamed kernel call asynchronously (optionally
-    pinned to ``device``); returns (res_device_array, starts)."""
+    pinned to ``device``); returns (res_device_array, starts). The
+    caller owns the readback (``np.asarray(res)``) — the pipelined
+    batch path (``checker.batch``) packs/stages the NEXT slice on the
+    host while this one runs on the device."""
     import jax
     import jax.numpy as jnp
 

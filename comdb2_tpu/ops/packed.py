@@ -4,18 +4,34 @@ This is the TPU-native analog of the reference's indexed op maps: every
 op becomes one row across parallel int arrays, with ``f`` and ``value``
 interned into id tables (the tensor equivalent of
 ``knossos/model/memo.clj:40-59``'s ``canonical-history``). All checker
-device code consumes this form; the Op objects never leave the host.
+device code consumes this form; the Op objects never leave the host —
+and since the columnar ingest rebuild they are not even MATERIALIZED
+unless an API edge (counterexample decode, report rendering) asks for
+``.ops``, which lazily rebuilds the completed indexed list from the
+arrays.
+
+The production packer is :mod:`comdb2_tpu.ops.columnar`; the per-op
+implementation below (:func:`pack_history_legacy`) is kept for one
+release behind ``COMDB2_TPU_LEGACY_PACK=1`` as a parity cross-check.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import os
+from dataclasses import dataclass, field
 from typing import Any, Dict, Hashable, List, Optional
 
 import numpy as np
 
-from .op import Op, TYPE_CODES
+from .op import Op, TYPE_CODES, TYPE_NAMES
 from . import history as hist
+
+
+def legacy_pack_enabled() -> bool:
+    """True when the per-op packer/segmenter should run instead of the
+    columnar path (``COMDB2_TPU_LEGACY_PACK=1``; read per call so
+    tests can toggle it)."""
+    return os.environ.get("COMDB2_TPU_LEGACY_PACK") == "1"
 
 
 @dataclass
@@ -24,7 +40,6 @@ class PackedHistory:
 
     Attributes
     ----------
-    ops:        the (completed, indexed) Op list — kept for reporting.
     process:    int32[n]  — interned process ids (see ``process_table``).
     type:       int8[n]   — 0 invoke / 1 ok / 2 fail / 3 info.
     f:          int32[n]  — interned f id.
@@ -38,9 +53,11 @@ class PackedHistory:
     fails:      bool[n]   — invocation will fail (skip in checkers).
     time:       int64[n]  — wall-clock nanos, -1 if unknown.
     *_table:    id → original object lookup lists.
+    ops_list:   the completed indexed Op list, or None — materialized
+                lazily via ``.ops`` (reporting only; the checkers never
+                read it).
     """
 
-    ops: List[Op]
     process: np.ndarray
     type: np.ndarray
     f: np.ndarray
@@ -53,13 +70,38 @@ class PackedHistory:
     f_table: List[Hashable]
     value_table: List[Any]
     transition_table: List[tuple]  # (f_id, value_id) per transition id
+    ops_list: Optional[List[Op]] = field(default=None, repr=False)
 
     def __len__(self) -> int:
-        return len(self.ops)
+        return len(self.process)
 
     @property
     def n_transitions(self) -> int:
         return len(self.transition_table)
+
+    @property
+    def ops(self) -> List[Op]:
+        """The completed, indexed Op list — an API-edge VIEW rebuilt
+        from the arrays on first access. Checker/device code must
+        consume the arrays, never this."""
+        if self.ops_list is None:
+            self.ops_list = _materialize_ops(self)
+        return self.ops_list
+
+
+def _materialize_ops(p: PackedHistory) -> List[Op]:
+    out: List[Op] = []
+    t = p.time.tolist()
+    fl = p.fails.tolist()
+    # the API edge: reporting needs real Op objects back
+    for i, (pc, tc, fc, vc) in enumerate(zip(  # analysis: ignore[per-op-host-loop]
+            p.process.tolist(), p.type.tolist(), p.f.tolist(),
+            p.value.tolist())):
+        out.append(Op(
+            process=p.process_table[pc], type=TYPE_NAMES[tc],
+            f=p.f_table[fc], value=p.value_table[vc], index=i,
+            time=None if t[i] < 0 else t[i], fails=fl[i]))
+    return out
 
 
 class _Interner:
@@ -83,7 +125,22 @@ def pack_history(history: List[Op], completed: bool = False) -> PackedHistory:
 
     Pass ``completed=True`` if the history already went through
     :func:`comdb2_tpu.ops.history.complete` and :func:`...history.index`.
+
+    Runs the columnar packer (:mod:`comdb2_tpu.ops.columnar`) — the
+    per-op implementation survives one release behind
+    ``COMDB2_TPU_LEGACY_PACK=1``; outputs are bit-identical
+    (tests/test_columnar_parity.py).
     """
+    if legacy_pack_enabled():
+        return pack_history_legacy(history, completed=completed)
+    from .columnar import pack_history_columnar
+
+    return pack_history_columnar(history, completed=completed)
+
+
+def pack_history_legacy(history: List[Op],
+                        completed: bool = False) -> PackedHistory:
+    """The original per-op packer (see :func:`pack_history`)."""
     if not completed:
         history = hist.complete(history, index=True)
     n = len(history)
@@ -121,9 +178,8 @@ def pack_history(history: List[Op], completed: bool = False) -> PackedHistory:
             pair[j] = i
 
     return PackedHistory(
-        ops=history,
         process=process, type=type_, f=f_arr, value=value, trans=trans,
         pair=pair, fails=fails, time=time,
         process_table=iproc.table, f_table=if_.table, value_table=ival.table,
         transition_table=itrans.table,
-    )
+        ops_list=list(history))

@@ -325,10 +325,22 @@ class VerifierCore:
                 hosts.append(p)
             else:
                 groups.setdefault((p.model, p.bucket), []).append(p)
+        # double-buffered staging: stage bucket i+1's host packing
+        # (pack_batch + segment/remap/chunk tensors) while the device
+        # still runs bucket i's dispatch — JAX dispatch is async, so
+        # only the finalize readback blocks. Depth 1 keeps at most two
+        # staged batches' tensors alive (host-compute vs
+        # device-compute overlap; this container has ONE CPU, so more
+        # depth buys nothing).
+        staged: deque = deque()
         for (model, bucket), items in groups.items():
             for i in range(0, len(items), self.batch_cap):
-                self._dispatch(model, bucket,
-                               items[i:i + self.batch_cap], done)
+                staged.append(self._dispatch_begin(
+                    model, bucket, items[i:i + self.batch_cap]))
+                while len(staged) > 1:
+                    staged.popleft()(done)
+        while staged:
+            staged.popleft()(done)
         for bucket, items in txn_groups.items():
             for i in range(0, len(items), self.batch_cap):
                 self._dispatch_txn(bucket,
@@ -357,12 +369,20 @@ class VerifierCore:
 
     def _dispatch(self, model_name: str, bucket: Bucket,
                   items: List[PendingRequest], done: list) -> None:
-        """ONE device dispatch for a bucket's chunk: every shape that
-        reaches a jit boundary is floored to the bucket, and the batch
-        axis is pow2-padded with copies of the first history, so all
-        chunks of this (bucket, B, sizes) class share one compiled
-        program."""
-        from ..checker.batch import check_batch, pack_batch
+        """Stage + finalize in one step (priming and direct callers;
+        the tick loop double-buffers via :meth:`_dispatch_begin`)."""
+        self._dispatch_begin(model_name, bucket, items)(done)
+
+    def _dispatch_begin(self, model_name: str, bucket: Bucket,
+                        items: List[PendingRequest]):
+        """Stage ONE device dispatch for a bucket's chunk and return a
+        ``finish(done)`` callable: every shape that reaches a jit
+        boundary is floored to the bucket, and the batch axis is
+        pow2-padded with copies of the first history, so all chunks of
+        this (bucket, B, sizes) class share one compiled program. The
+        device runs between stage and finish — the tick loop stages
+        the NEXT chunk's host packing in that window."""
+        from ..checker.batch import check_batch_async, pack_batch
         from ..models.memo import MemoOverflow
         from ..models.model import MODELS
 
@@ -376,43 +396,62 @@ class VerifierCore:
                                n_pad=bucket.n_pad)
             ns = _next_pow2(batch.memo.n_states)
             nt = _next_pow2(batch.memo.n_transitions)
-            status, fail_at, n_final = check_batch(
+            fin = check_batch_async(
                 batch, F=self.F, engine=self.engine, info=info,
                 s_pad=bucket.S, k_pad=bucket.K,
                 n_states_pad=ns, n_transitions_pad=nt,
                 p_eff_pad=bucket.P_eff)
         except MemoOverflow as e:
-            self._fail_batch(items, bucket, f"memo overflow: {e}", done)
-            return
+            cause = f"memo overflow: {e}"
+            return lambda done: self._fail_batch(items, bucket, cause,
+                                                 done)
         except Exception as e:                  # noqa: BLE001
             # an engine blowup degrades THIS chunk to unknown; the
             # daemon must keep serving other buckets
-            self._fail_batch(items, bucket,
-                             f"{type(e).__name__}: {e}", done)
-            return
-        if self.inject_dispatch_latency_s > 0.0:
-            time.sleep(self.inject_dispatch_latency_s)
-        eng = info.get("engine", self.engine)
-        pk = (model_name, bucket.key, b_prog, ns, nt, self.F, eng)
-        bs = self._bstats(bucket.key)
-        bs.dispatches += 1
-        bs.batched += len(items)
-        bs.occupancy_sum += len(items) / b_prog
-        bs.device_s += time.monotonic() - t0
-        if pk in self._programs:
-            self.m["program_hits"] += 1
-        else:
-            self._programs.add(pk)
-            bs.compiles += 1
-            self.m["compiles"] += 1
-        bs.programs.add(pk)
-        self.m["dispatches"] += 1
-        for i, p in enumerate(items):
-            self._finish(p, self._reply(
-                p.rid, protocol.verdict(status[i]),
-                op_index=int(fail_at[i]), final_count=int(n_final[i]),
-                engine=eng, bucket=bucket.key, batched=len(items)),
-                done)
+            cause = f"{type(e).__name__}: {e}"
+            return lambda done: self._fail_batch(items, bucket, cause,
+                                                 done)
+
+        t_staged = time.monotonic()
+
+        def finish(done: list) -> None:
+            t_fin = time.monotonic()
+            try:
+                status, fail_at, n_final = fin()
+            except Exception as e:              # noqa: BLE001
+                self._fail_batch(items, bucket,
+                                 f"{type(e).__name__}: {e}", done)
+                return
+            if self.inject_dispatch_latency_s > 0.0:
+                time.sleep(self.inject_dispatch_latency_s)
+            eng = info.get("engine", self.engine)
+            pk = (model_name, bucket.key, b_prog, ns, nt, self.F, eng)
+            bs = self._bstats(bucket.key)
+            bs.dispatches += 1
+            bs.batched += len(items)
+            bs.occupancy_sum += len(items) / b_prog
+            # stage duration + finalize wait for THIS dispatch only:
+            # under the tick loop's double buffer, wall time between
+            # stage and finish belongs to the NEXT bucket's host pack
+            # and must not inflate this bucket's device seconds
+            bs.device_s += (t_staged - t0) + (time.monotonic() - t_fin)
+            if pk in self._programs:
+                self.m["program_hits"] += 1
+            else:
+                self._programs.add(pk)
+                bs.compiles += 1
+                self.m["compiles"] += 1
+            bs.programs.add(pk)
+            self.m["dispatches"] += 1
+            for i, p in enumerate(items):
+                self._finish(p, self._reply(
+                    p.rid, protocol.verdict(status[i]),
+                    op_index=int(fail_at[i]),
+                    final_count=int(n_final[i]),
+                    engine=eng, bucket=bucket.key,
+                    batched=len(items)), done)
+
+        return finish
 
     def _fail_batch(self, items, bucket, cause, done) -> None:
         self.m["engine_errors"] += 1

@@ -12,6 +12,11 @@ export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
 echo "== static invariant checker =="
 python -m comdb2_tpu.analysis
 
+echo "== pack parity smoke (legacy vs columnar ingest) =="
+# one fixture per corpus family; any segment-stream diff fails CI
+# before the slow tier ever runs
+JAX_PLATFORMS=cpu python scripts/pack_parity_smoke.py
+
 echo "== native configure/build with ASan =="
 if command -v cmake >/dev/null; then
     cmake -DCT_SANITIZE=address -S native -B native/build-asan \

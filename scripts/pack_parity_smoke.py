@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Pack-parity smoke for scripts/check.sh: one fixture per corpus
+family through BOTH ingest paths (legacy per-op vs columnar); the
+diff of packed arrays, segment streams, and renamed slots must be
+EMPTY. Catches packer drift in seconds without the slow tier —
+the exhaustive sweep lives in tests/test_columnar_parity.py.
+
+Exit 0 = bit-identical everywhere; exit 1 = drift (differences named).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from comdb2_tpu.checker import linear_jax as LJ
+    from comdb2_tpu.checker.independent import wrap_keyed_history
+    from comdb2_tpu.ops import op as O
+    from comdb2_tpu.ops.columnar import pack_history_columnar
+    from comdb2_tpu.ops.packed import pack_history_legacy
+    from comdb2_tpu.ops.synth import (list_append_history,
+                                      pinned_wide_history,
+                                      register_history)
+
+    rng = random.Random(77)
+    keyed = []
+    for _ in range(20):
+        k, p, v = rng.randrange(3), rng.randrange(3), rng.randrange(3)
+        keyed += [O.invoke(p, "write", (k, v)), O.ok(p, "write", (k, v))]
+    families = {
+        "register": register_history(rng, n_procs=5, n_events=200,
+                                     values=5, p_info=0.0),
+        "cas-p10": register_history(rng, n_procs=10, n_events=200,
+                                    values=5, p_info=0.0,
+                                    max_pending=5),
+        "crash-heavy": register_history(rng, n_procs=4, n_events=200,
+                                        values=3, p_info=0.3),
+        "keyed": wrap_keyed_history(keyed),
+        "wide-p-pinned": pinned_wide_history(18),
+        "txn-list-append": list_append_history(rng, n_procs=3,
+                                               n_txns=30),
+    }
+    bad = 0
+    for name, hist in families.items():
+        legacy = pack_history_legacy(hist)
+        col = pack_history_columnar(hist)
+        diffs = []
+        for f in ("process", "type", "f", "value", "trans", "pair",
+                  "fails", "time"):
+            if not np.array_equal(getattr(legacy, f), getattr(col, f)):
+                diffs.append(f)
+        for f in ("process_table", "f_table", "value_table",
+                  "transition_table"):
+            if getattr(legacy, f) != getattr(col, f):
+                diffs.append(f)
+        ls = LJ.make_segments_legacy(legacy)
+        cs = LJ.make_segments(col)
+        for f in ls._fields:
+            if not np.array_equal(getattr(ls, f), getattr(cs, f)):
+                diffs.append(f"segments.{f}")
+        lr, lp = LJ.remap_slots(ls)
+        (cr,), (cp,) = LJ.remap_slots_batch([cs])
+        if lp != cp:
+            diffs.append("p_eff")
+        for f in lr._fields:
+            if not np.array_equal(getattr(lr, f), getattr(cr, f)):
+                diffs.append(f"remap.{f}")
+        if diffs:
+            bad += 1
+            print(f"DRIFT {name}: {', '.join(diffs)}")
+        else:
+            print(f"ok {name}")
+    if bad:
+        print(f"FAIL: {bad} family/families drifted")
+        return 1
+    print("OK: columnar ingest bit-identical to the legacy packer on "
+          f"{len(families)} families")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

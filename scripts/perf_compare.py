@@ -1,9 +1,13 @@
 #!/usr/bin/env python3
-"""Compare single-history device engines on the bench shape (real TPU).
+"""Compare single-history device engines on the bench shape (real TPU)
+plus the host-ingest paths (legacy per-op vs columnar; CPU-only work).
 
 Usage: PYTHONPATH=$AXON_SITE:. python scripts/perf_compare.py [n_ops]
 Reports ops/s for each engine on the 50k-op register history; asserts
-every engine reaches the known-correct verdict.
+every engine reaches the known-correct verdict. The host-ingest
+section runs the legacy per-op packer (the ``COMDB2_TPU_LEGACY_PACK=1``
+path) against the columnar packer on 3 shapes, asserting bit-identical
+streams before trusting either timing.
 """
 from __future__ import annotations
 
@@ -12,11 +16,65 @@ import sys
 import time
 
 
+def host_ingest_section() -> None:
+    """Legacy per-op vs columnar ingest (pack -> segments -> remap) on
+    3 shapes; outputs must match bit-for-bit before timings count."""
+    import numpy as np
+
+    from comdb2_tpu.checker import linear_jax as LJ
+    from comdb2_tpu.ops.columnar import pack_history_columnar
+    from comdb2_tpu.ops.packed import pack_history_legacy
+    from comdb2_tpu.ops.synth import register_history
+
+    print("-- host ingest: legacy per-op vs columnar "
+          "(pack+segment+remap) --", flush=True)
+    for B, events in ((64, 400), (32, 2000), (8, 8000)):
+        hs = [register_history(random.Random(9000 + i), n_procs=5,
+                               n_events=events, values=5, p_info=0.0)
+              for i in range(B)]
+        n_inv = sum(1 for h in hs for op in h if op.type == "invoke")
+
+        t0 = time.perf_counter()
+        pl = [pack_history_legacy(h) for h in hs]
+        sl = [LJ.make_segments_legacy(p) for p in pl]
+        rl = [LJ.remap_slots(s) for s in sl]
+        dt_legacy = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        pc = [pack_history_columnar(h) for h in hs]
+        sc = [LJ.make_segments(p) for p in pc]
+        rc, pes = LJ.remap_slots_batch(sc)
+        dt_col = time.perf_counter() - t0
+
+        for (ls, lpe), cs, cpe in zip(rl, rc, pes):
+            assert lpe == cpe
+            for f in ls._fields:
+                assert np.array_equal(getattr(ls, f), getattr(cs, f))
+        print(f"ingest {B}x{events:<5d} legacy {n_inv / dt_legacy:9.0f}"
+              f" ops/s   columnar {n_inv / dt_col:9.0f} ops/s   "
+              f"x{dt_legacy / dt_col:.1f}", flush=True)
+
+    # the bench path goes further: whole-batch columnar GENERATION
+    # straight into packed arrays (no Op objects at all)
+    from comdb2_tpu.ops import synth_columnar as SC
+
+    t0 = time.perf_counter()
+    ps = SC.register_batch_packed(9000, 32, 1000, n_procs=5, values=5)
+    segs = [LJ.make_segments(p) for p in ps]
+    LJ.remap_slots_batch(segs)
+    dt = time.perf_counter() - t0
+    n_inv = 32 * 1000
+    print(f"ingest 32x2000 columnar-gen {n_inv / dt:9.0f} ops/s   "
+          "(arrays end-to-end, the 4096x bench path)", flush=True)
+
+
 def main() -> None:
     import jax
 
     from comdb2_tpu.utils.platform import enable_compile_cache
     enable_compile_cache()
+
+    host_ingest_section()
 
     from comdb2_tpu.checker import linear_jax as LJ
     from comdb2_tpu.models.memo import memo as make_memo

@@ -150,17 +150,18 @@ def test_check_batch_stream_unknown_escalates(monkeypatch):
     batch = B.pack_batch(hs, M.cas_register())
     want = B.check_batch(batch, engine="keys")
 
-    def fake_stream(succ, segs_list, *, n_states, n_transitions, P,
-                    devices=None):
-        # history 2 pretends to overflow the kernel frontier
-        out = []
-        for b in range(len(segs_list)):
-            out.append((2, 0, 0) if b == 2 else (0, -1, 1))
-        return out
+    def fake_dispatch(succ, segs_list, spec, n_states, n_transitions,
+                      device=None):
+        # history 2 pretends to overflow the kernel frontier (one
+        # pipeline slice: slice-local indices are batch indices)
+        import numpy as np
+
+        res = np.array([[2, 0, 0] if b == 2 else [0, -1, 1]
+                        for b in range(len(segs_list))], np.int32)
+        return res, np.zeros(len(segs_list), np.int64)
 
     monkeypatch.setattr(B.PSEG, "available", lambda: True)
-    monkeypatch.setattr(B.PSEG, "check_device_pallas_stream",
-                        fake_stream)
+    monkeypatch.setattr(B.PSEG, "stream_dispatch", fake_dispatch)
     st, fa, n = B.check_batch(batch, F=256, engine="stream")
     assert (st == want[0]).all()          # UNKNOWN replaced by verdict
     assert n[2] == want[2][2]             # escalated lane's real count

@@ -134,11 +134,12 @@ def test_merge_escalation_folds_subbatch_back():
 
 
 def test_f_escalation_wiring_with_fake_stream_engine(monkeypatch):
-    """The escalation WIRING in _check_batch_impl, exercised on CPU by
-    faking the stream engine (the real kernel doesn't lower here): the
-    fake reports UNKNOWN for one history, and check_batch must route
-    exactly that history through the real XLA engines at the caller's
-    F and fold the resolved verdict back — final results equal solo."""
+    """The escalation WIRING in check_batch's stream path, exercised
+    on CPU by faking the per-slice dispatch (the real kernel doesn't
+    lower here): the fake reports UNKNOWN for one history, and
+    check_batch must route exactly that history through the real XLA
+    engines at the caller's F and fold the resolved verdict back —
+    final results equal solo."""
     import random
 
     from comdb2_tpu.models.model import cas_register
@@ -153,19 +154,21 @@ def test_f_escalation_wiring_with_fake_stream_engine(monkeypatch):
 
     batch = B.pack_batch(hs, cas_register())
 
-    def fake_stream(succ, segs_list, **kw):
-        # history 2 "overflows the kernel frontier"; others check out.
-        out = []
+    def fake_dispatch(succ, segs_list, spec, n_states, n_transitions,
+                      device=None):
+        # history 2 "overflows the kernel frontier"; others check out
+        # (4 histories = one pipeline slice, so slice-local indices
+        # are batch indices)
+        res = np.zeros((len(segs_list), 3), np.int32)
         for i in range(len(segs_list)):
             if i == 2:
-                out.append((LJ.UNKNOWN, -1, 0))
+                res[i] = (LJ.UNKNOWN, -1, 0)
             else:
-                out.append((int(solo[i][0][0]), -1,
-                            int(solo[i][2][0])))
-        return out
+                res[i] = (int(solo[i][0][0]), -1, int(solo[i][2][0]))
+        return res, np.zeros(len(segs_list), np.int64)
 
     monkeypatch.setattr(PSEG, "available", lambda: True)
-    monkeypatch.setattr(PSEG, "check_device_pallas_stream", fake_stream)
+    monkeypatch.setattr(PSEG, "stream_dispatch", fake_dispatch)
 
     info: dict = {}
     status, fail_at, n_final = B.check_batch(batch, F=1024,
