@@ -25,11 +25,14 @@ short story per rule id:
   history; an ok/fail completion would let the nemesis affect the
   model.
 - ``per-item-dispatch`` — a loop dispatching ``check_device_batch`` /
-  ``check_device`` per item is round-trip-bound: each dispatch pays
-  the ~100 ms tunnel round-trip (measured 1.5k ops/s serial vs 93k
-  streamed). Pack the items into ONE ``checker.batch.pack_batch`` /
-  ``check_batch`` call, or submit them to the ``comdb2_tpu.service``
-  verifier daemon, which coalesces callers into shared dispatches.
+  ``check_device`` (or ``closure_diag``/``cyclic_layers_device`` on
+  the txn axis, or the shrink serial control ``check_candidate``) per
+  item is round-trip-bound: each dispatch pays the ~100 ms tunnel
+  round-trip (measured 1.5k ops/s serial vs 93k streamed). Pack the
+  items into ONE ``checker.batch.pack_batch`` / ``check_batch`` /
+  ``shrink.verdicts.check_candidates`` call, or submit them to the
+  ``comdb2_tpu.service`` verifier daemon, which coalesces callers
+  into shared dispatches.
 - ``per-op-host-loop`` — the pack/segment ingest path is columnar
   since round 6 (the per-op walk measured ``host_pack_s = 278.2``
   against ~70 s of device time at the 4096x bench shape); a ``for``
@@ -56,9 +59,14 @@ PARSE_NAMES = {"parse_history", "parse_history_fast"}
 #: legitimate, so only the per-history entries are flagged). The txn
 #: closure engine's entries are covered too: one cycle check per
 #: dependency graph must ride ``closure_diag_batch`` (or the service
-#: txn kind), never a loop of ``closure_diag`` calls.
+#: txn kind), never a loop of ``closure_diag`` calls. The shrink
+#: entry point ``check_candidate`` is covered for the same reason:
+#: one verdict dispatch per ddmin candidate is the bug the shrink
+#: subsystem exists to avoid — a round's candidates ride ONE
+#: ``shrink.verdicts.check_candidates`` call per shape bucket.
 PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device",
-                           "closure_diag", "cyclic_layers_device"}
+                           "closure_diag", "cyclic_layers_device",
+                           "check_candidate"}
 
 #: modules forming the columnar pack/segment ingest path — a per-op
 #: ``for ... in <x>.ops`` loop there is the ``per-op-host-loop``
@@ -400,7 +408,8 @@ def lint_file(path: str, source: Optional[str] = None) -> List[Finding]:
                 f"{fname} dispatched inside a loop — per-item device "
                 "calls are round-trip-bound (measured 1.5k vs 93k "
                 "ops/s); pack the items through checker.batch."
-                "pack_batch/check_batch or submit them to the "
+                "pack_batch/check_batch (shrink candidates: shrink."
+                "verdicts.check_candidates) or submit them to the "
                 "comdb2_tpu.service verifier daemon"))
 
     if base in PACK_SEGMENT_MODULES or "pack" in base:

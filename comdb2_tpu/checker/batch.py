@@ -166,6 +166,33 @@ def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
                        tr=np.stack(trs), P=P, remaps=remaps)
 
 
+def pack_batch_masked(parent: PackedHistory, masks: Sequence,
+                      memo: MemoizedModel) -> PackedBatch:
+    """The shrink fast path: B sub-history candidates of ONE packed
+    parent as a :class:`PackedBatch` WITHOUT re-packing or
+    re-interning. Every candidate is a pair-closed row slice
+    (:func:`~comdb2_tpu.ops.columnar.subset_packed`) whose id tables
+    ARE the parent's, so the union transition table is the parent's
+    and every remap is the identity — the O(ops·B) union pass of
+    :func:`pack_batch` disappears, which is what lets a ddmin round
+    test dozens of candidate sub-histories per dispatch.
+
+    ``memo`` must be memoized over the parent's transitions with a
+    depth bound >= the parent's invoke count (a candidate can't
+    linearize more ops than the parent invoked, so one memo serves
+    every round). Packed with the ``build_streams=False`` layout —
+    candidates check through the stream/keys/flat engines."""
+    from ..ops.columnar import subset_packed
+
+    packeds = [subset_packed(parent, m) for m in masks]
+    ident = np.arange(len(parent.transition_table), dtype=np.int32)
+    empty = np.zeros((len(packeds), 0), np.int32)
+    return PackedBatch(packeds=packeds, memo=memo, kind=empty,
+                       proc=empty, tr=empty,
+                       P=max(len(parent.process_table), 1),
+                       remaps=[ident] * len(packeds))
+
+
 @dataclass
 class SegmentBatch:
     """Per-ok segment tensors for the flat engine: (S, B, K) layouts."""

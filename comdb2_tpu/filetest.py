@@ -48,6 +48,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "checking locally — no local JAX backend is "
                         "touched; exits 3 on a daemon error reply "
                         "(overload/bad-request: nothing was checked)")
+    p.add_argument("--shrink", action="store_true",
+                   help="on INVALID, minimize to a 1-minimal "
+                        "sub-history (completion-pair ddmin, batched "
+                        "on device — docs/shrink.md) and write "
+                        "minimal.edn + a re-rendered SVG into the "
+                        "store (see --store); the exit code stays the "
+                        "seed verdict's")
+    p.add_argument("--store", default="store", metavar="DIR",
+                   help="store root for --shrink artifacts (default "
+                        "store/ — the run shows up in the store web "
+                        "index like any harness run)")
     args = p.parse_args(argv)
     if args.txn:
         args.checker = "txn"
@@ -63,7 +74,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             text = fh.read()
         try:
             with ServiceClient(host or "127.0.0.1", int(port)) as c:
-                if args.checker == "txn":
+                if args.shrink:
+                    reply = c.shrink(text,
+                                     txn=(args.checker == "txn"),
+                                     realtime=args.realtime,
+                                     model=(None if args.checker ==
+                                            "txn" else args.model),
+                                     keyed=args.keyed,
+                                     raise_on_error=False)
+                elif args.checker == "txn":
                     reply = c.check(text, txn=True,
                                     realtime=args.realtime,
                                     raise_on_error=False)
@@ -77,7 +96,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             # that never happened
             print(f"verifier service error: {e}", file=sys.stderr)
             return 3
-        pprint.pprint(reply)
+        if args.shrink and reply.get("ok") \
+                and reply.get("minimal_history"):
+            # persist the daemon's minimal history exactly like the
+            # local path (the SVG re-render re-checks on host). The
+            # reply's EDN is RAW: keyed [k v] values must re-wrap
+            # before the host re-check or they parse as cas pairs
+            from .ops.native_loader import parse_history_fast
+
+            mops = parse_history_fast(reply["minimal_history"])
+            if (args.keyed or args.model == "cas-register-comdb2") \
+                    and args.checker != "txn":
+                from .checker.independent import wrap_keyed_history
+
+                mops = wrap_keyed_history(mops)
+            _save_shrink_artifacts(mops, reply, args)
+        pprint.pprint({k: v for k, v in reply.items()
+                       if k != "minimal_history"})
         if not reply.get("ok"):
             # overload/bad-request: the history was NEVER CHECKED —
             # exit 1 would record a linearizability violation that
@@ -91,10 +126,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         return 1
 
-    if args.checker in ("linear", "txn") and args.backend != "host":
+    if (args.checker in ("linear", "txn") and args.backend != "host") \
+            or args.shrink:
         # only the device frontier search needs a JAX backend; the set
         # and wgl checkers (and host linear) are pure host Python, and
-        # in the ambient env touching jax attaches the tunneled TPU
+        # in the ambient env touching jax attaches the tunneled TPU.
+        # --shrink always needs it: candidate verdicts are device
+        # dispatches even when the seed check ran --backend host
         from .utils.platform import ensure_backend
 
         ensure_backend()
@@ -142,11 +180,81 @@ def main(argv: Optional[List[str]] = None) -> int:
         pprint.pprint(result)
         valid = a.valid
 
+    if args.shrink:
+        if args.checker not in ("linear", "txn"):
+            print("--shrink supports the linear and txn checkers "
+                  "only", file=sys.stderr)
+        elif valid is not False:
+            # the seed-rejection contract: shrinking a VALID history
+            # has nothing to preserve, shrinking an UNKNOWN would
+            # loop on capacity-limited verdicts
+            print(f"--shrink: seed verdict is {valid!r} — only "
+                  "INVALID histories shrink", file=sys.stderr)
+        else:
+            from .shrink import SeedVerdictError, minimize
+
+            try:
+                r = minimize(history,
+                             checker=("txn" if args.checker == "txn"
+                                      else "linear"),
+                             model=args.model, realtime=args.realtime)
+            except SeedVerdictError as e:
+                # the main analysis escalates frontier capacity (or
+                # ran on host); the shrinker's fixed-F seed re-check
+                # can still come back UNKNOWN — degrade gracefully,
+                # exactly like the not-INVALID branch above
+                print(f"--shrink: {e}", file=sys.stderr)
+            else:
+                _save_shrink_artifacts(r.ops, r, args)
+
     if valid is True:
         return 0
     if valid == "unknown":
         return 2
     return 1
+
+
+def _save_shrink_artifacts(ops, result, args) -> None:
+    """Persist minimal.edn + results.edn + the re-rendered SVG into
+    the store (one run dir, linked from the store web index).
+    ``result`` is a ShrinkResult (local path) or the daemon's reply
+    dict (service path); the SVG re-render re-checks the minimal
+    history on host and the verdict lands in results.edn."""
+    from .harness.store import save_shrink
+    from .ops.history import history_to_edn
+    from .report import shrink_svg
+
+    checker = "txn" if args.checker == "txn" else "linear"
+    rv, svg = shrink_svg.render_minimal(
+        list(ops), checker=checker, model=args.model,
+        realtime=args.realtime)
+    if isinstance(result, dict):
+        rm = {"valid?": result.get("valid"), "checker": checker,
+              "seed-ops": result.get("seed_ops"),
+              "minimal-ops": result.get("minimal_ops"),
+              "rounds": result.get("rounds"),
+              "candidates": result.get("candidates"),
+              "dispatches": result.get("dispatches"),
+              "one-minimal?": result.get("one_minimal"),
+              "partial?": result.get("partial"),
+              "reverified-valid?": rv}
+        # the reply flattens ShrinkResult.extra (txn diagnosis etc.)
+        # — persist it like the local path's results_map does
+        for k in ("txns", "evidence_txns", "anomaly_class",
+                  "seed_class", "anomalies", "note", "cause"):
+            if k in result:
+                rm[k.replace("_", "-")] = result[k]
+    else:
+        rm = shrink_svg.results_map(result, reverified=rv)
+    d = save_shrink(history_to_edn(list(ops)), rm, svg=svg,
+                    store_root=args.store)
+    print(f"shrink: {len(ops)} ops -> {d}/minimal.edn",
+          file=sys.stderr)
+    if rv is not False:
+        # a clean re-check means the minimizer and the offline
+        # checker disagree — surface it, never hide it
+        print(f"shrink: WARNING minimal history re-checked {rv!r}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -184,6 +184,33 @@ def save_service_status(status: dict,
     return latest
 
 
+def save_shrink(minimal_edn: str, results: dict,
+                svg: Optional[str] = None,
+                store_root: str = "store",
+                name: str = "shrink") -> str:
+    """Persist a shrink run like a test run: ``store/<name>/<ts>/``
+    with ``minimal.edn`` (the 1-minimal sub-history — re-checkable
+    offline via ``filetest``, the same replayability contract as
+    ``history.edn``), ``results.edn`` (the minimization stats, with
+    ``valid?`` so the store web index color-codes the row like any
+    other run) and, when given, the re-rendered counterexample
+    ``shrink.svg``. Returns the run directory."""
+    import time
+
+    ts = (time.strftime("%Y%m%dT%H%M%S")
+          + f"-{time.time_ns() % 1_000_000:06d}")
+    test = {"name": name, "start-time": ts, "store-root": store_root}
+    with open(path_mkdirs(test, "minimal.edn"), "w") as fh:
+        fh.write(minimal_edn)
+    with open(path_mkdirs(test, "results.edn"), "w") as fh:
+        fh.write(write_edn(_edn_safe(results)))
+    if svg is not None:
+        with open(path_mkdirs(test, "shrink.svg"), "w") as fh:
+            fh.write(svg)
+    update_symlinks(test)
+    return path(test)
+
+
 _handlers: dict = {}
 
 

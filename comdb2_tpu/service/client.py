@@ -135,6 +135,41 @@ class ServiceClient:
                                reply.get("message", ""))
         return reply
 
+    def shrink(self, history: Union[str, List, None] = None, *,
+               model: Optional[str] = None, keyed: bool = False,
+               txn: bool = False, realtime: bool = False,
+               deadline_ms: Optional[int] = None,
+               raise_on_error: bool = True) -> dict:
+        """Minimize one INVALID history (``kind: "shrink"``). The
+        reply carries ``minimal_history`` (EDN text of the 1-minimal
+        sub-history), ``minimal_ops``/``seed_ops``, round/dispatch
+        counts and the ``one_minimal``/``partial`` flags; a deadline
+        returns best-so-far flagged ``partial``. A VALID/UNKNOWN seed
+        answers ``bad-request`` (shrinking it is an error, not a
+        loop)."""
+        if not isinstance(history, str):
+            from ..ops.history import history_to_edn
+
+            history = history_to_edn(list(history or []))
+        self._seq += 1
+        req: dict = {"op": "check", "id": self._seq, "kind": "shrink",
+                     "history": history}
+        if txn:
+            req["txn"] = True
+            if realtime:
+                req["realtime"] = True
+        if model is not None:
+            req["model"] = model
+        if keyed:
+            req["keyed"] = True
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+        reply = self._request(req)
+        if raise_on_error and not reply.get("ok"):
+            raise ServiceError(reply.get("error", "unknown-error"),
+                               reply.get("message", ""))
+        return reply
+
     def status(self) -> dict:
         return self._request({"op": "status"})
 

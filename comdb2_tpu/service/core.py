@@ -118,6 +118,7 @@ class VerifierCore:
             "bad_requests": 0, "malformed": 0, "deadline_expired": 0,
             "host_degraded": 0, "engine_errors": 0, "dispatches": 0,
             "compiles": 0, "program_hits": 0, "primed": 0,
+            "shrink_requests": 0, "shrink_rounds": 0,
         }
 
     # -- admission -----------------------------------------------------
@@ -138,6 +139,8 @@ class VerifierCore:
         kind = req.get("kind", "check")
         if kind == "txn":
             return self._submit_txn(req, now, ctx, rid)
+        if kind == "shrink":
+            return self._submit_shrink(req, now, ctx, rid)
         if kind != "check":
             self.m["bad_requests"] += 1
             return None, protocol.error_reply(
@@ -277,6 +280,115 @@ class VerifierCore:
         self.queue.append(pending)
         return pending, None
 
+    # -- shrink-kind admission -----------------------------------------
+
+    def _submit_shrink(self, req: dict, now: float, ctx: object, rid):
+        """Admit one counterexample-minimization request. The job
+        (a step-driven :class:`~comdb2_tpu.shrink.core.DdminEngine`)
+        rides the SAME queue, overload backpressure and deadline
+        expiry as every other kind; each tick advances it one ddmin
+        round — shrink rounds are just more pow2-bucketed batch
+        traffic — and a deadline returns best-so-far flagged
+        ``partial``."""
+        txn = bool(req.get("txn"))
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, "missing history (EDN text)", rid)
+        model = req.get("model") or self.model
+        realtime = bool(req.get("realtime"))
+        from ..models.model import MODELS
+
+        if not txn and model not in MODELS:
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unknown model {model!r}", rid)
+        dl = req.get("deadline_ms")
+        if dl is not None and not isinstance(dl, (int, float)):
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"deadline_ms must be a number, got {type(dl).__name__}",
+                rid)
+        try:
+            if txn:
+                ops = self._parse(text, "txn", keyed=False)
+            else:
+                ops = self._parse(text, model,
+                                  keyed=bool(req.get("keyed")))
+        except Exception as e:              # noqa: BLE001 — client data
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unparseable history: {e}", rid)
+        # one ddmin round runs synchronously inside a tick: cap its
+        # candidate budget so a pathological seed costs a bounded
+        # number of dispatches per tick instead of wedging every
+        # other request past its deadline
+        round_cap = max(2 * self.batch_cap, 8)
+        try:
+            if txn:
+                from ..shrink import TxnShrinker
+
+                job = TxnShrinker(ops, realtime=realtime,
+                                  round_cap=round_cap)
+            else:
+                from ..shrink import Shrinker
+
+                if not ops or not any(op.type == "ok" for op in ops):
+                    # trivially VALID: nothing constrains the frontier
+                    # — a shrink of it is a client error, answered
+                    # without burning a tick (seed-rejection contract)
+                    self.m["bad_requests"] += 1
+                    return None, protocol.error_reply(
+                        protocol.BAD_REQUEST,
+                        "seed verdict is True — only INVALID "
+                        "histories shrink", rid)
+                job = Shrinker(ops, MODELS[model](), F=self.F,
+                               engine=self.engine,
+                               max_batch=self.batch_cap,
+                               round_cap=round_cap)
+        except (ValueError, RuntimeError) as e:
+            # includes MemoOverflow and malformed histories: the
+            # tri-state's honest answer, same as the check kind
+            self.m["malformed"] += 1
+            return None, self._reply(rid, "unknown", kind="shrink",
+                                     cause=f"malformed: {e}")
+        self.m["accepted"] += 1
+        self.m["shrink_requests"] += 1
+        pending = PendingRequest(
+            rid=rid, model=model, packed=job, bucket=None,
+            t_in=now, ctx=ctx, kind="shrink", realtime=realtime,
+            t_dead=(now + float(dl) / 1e3) if dl is not None else None)
+        self.queue.append(pending)
+        return pending, None
+
+    def _shrink_reply(self, p: PendingRequest, job,
+                      partial: bool = False, **extra) -> dict:
+        """Wire reply for a finished (or deadline-cut) shrink job."""
+        if job.error is not None:
+            # seed was VALID/UNKNOWN: an error, not a loop — the
+            # client gets the observed verdict in the message
+            self.m["bad_requests"] += 1
+            return protocol.error_reply(protocol.BAD_REQUEST,
+                                        str(job.error), p.rid)
+        r = job.result(partial=partial)
+        from ..ops.history import history_to_edn
+
+        out = self._reply(
+            p.rid, r.valid, kind="shrink",
+            seed_ops=r.seed_ops, minimal_ops=r.n_ops,
+            rounds=r.rounds, candidates=r.candidates,
+            dispatches=r.dispatches, one_minimal=r.one_minimal,
+            partial=r.partial, **r.extra, **extra)
+        if r.n_ops <= 2048:
+            out["minimal_history"] = history_to_edn(r.ops)
+        else:
+            # a deadline-cut 100k-event best-so-far must not blow up
+            # the reply framing; the caller re-submits with more time
+            out["minimal_history_omitted"] = True
+        return out
+
     def _txn_reply(self, rid, result: dict, **extra) -> dict:
         """Compress a check_txn result map into a wire reply."""
         cex = result.get("counterexample")
@@ -315,8 +427,11 @@ class VerifierCore:
         groups: Dict[tuple, List[PendingRequest]] = {}
         txn_groups: Dict[TxnBucket, List[PendingRequest]] = {}
         hosts: List[PendingRequest] = []
+        shrinks: List[PendingRequest] = []
         for p in work:
-            if p.kind == "txn":
+            if p.kind == "shrink":
+                shrinks.append(p)
+            elif p.kind == "txn":
                 if p.bucket is None:
                     hosts.append(p)
                 else:
@@ -350,6 +465,32 @@ class VerifierCore:
                 self._host_check_txn(p, done)
             else:
                 self._host_check(p, done)
+        # shrink jobs advance ONE ddmin round per tick (candidate
+        # budget capped at admission via round_cap, so a round is a
+        # bounded number of pow2-bucketed dispatches) and re-queue
+        # until done — long minimizations interleave with serving
+        # traffic instead of wedging the single-threaded loop
+        for p in shrinks:
+            job = p.packed
+            d0 = job.counters["dispatches"]
+            try:
+                finished = job.step()
+            except Exception as e:              # noqa: BLE001
+                self.m["engine_errors"] += 1
+                self._finish(p, self._reply(
+                    p.rid, "unknown", kind="shrink",
+                    cause=f"engine: {type(e).__name__}: {e}"), done)
+                continue
+            self.m["shrink_rounds"] += 1
+            if self.inject_dispatch_latency_s > 0.0:
+                # per DISPATCH, like the check/txn kinds — the knob
+                # models the tunnel round-trip each dispatch pays
+                time.sleep(self.inject_dispatch_latency_s
+                           * (job.counters["dispatches"] - d0))
+            if finished:
+                self._finish(p, self._shrink_reply(p, job), done)
+            else:
+                self.queue.append(p)
         return done
 
     def _expire(self, now: float, done: list) -> None:
@@ -359,6 +500,15 @@ class VerifierCore:
         for p in self.queue:
             if p.t_dead is not None and now >= p.t_dead:
                 self.m["deadline_expired"] += 1
+                if p.kind == "shrink":
+                    # deadline returns BEST-SO-FAR, flagged partial —
+                    # a half-finished minimization is still a smaller
+                    # repro than the seed (seed-rejection errors keep
+                    # their error reply)
+                    self._finish(p, self._shrink_reply(
+                        p, p.packed, partial=True, cause="deadline"),
+                        done)
+                    continue
                 extra = {"kind": "txn"} if p.kind == "txn" else {}
                 self._finish(p, self._reply(p.rid, "unknown",
                                             cause="deadline",

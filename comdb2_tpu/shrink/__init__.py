@@ -1,0 +1,23 @@
+"""TPU-batched counterexample minimization (delta debugging as a
+device workload).
+
+``minimize(history, checker=...)`` takes an INVALID history and
+returns a **1-minimal** sub-history: removing any remaining
+invoke/complete pair (linearizability axis) or transaction (txn axis)
+yields VALID/UNKNOWN. Each ddmin round's candidate set is generated as
+columnar array slices of one packed parent and verdict-tested in ONE
+device dispatch per pow2 shape bucket — see ``docs/shrink.md``.
+
+Surfaces: this API, ``python -m comdb2_tpu.filetest --shrink`` (store
+artifacts: ``minimal.edn`` + re-rendered SVG), and the verifier
+service's ``kind: "shrink"`` request.
+"""
+
+from .core import (DdminEngine, SeedVerdictError, ShrinkResult,
+                   Shrinker, atoms_of, minimize)
+from .txn import TxnShrinker
+from .verdicts import check_candidate, check_candidates
+
+__all__ = ["DdminEngine", "SeedVerdictError", "ShrinkResult",
+           "Shrinker", "TxnShrinker", "atoms_of", "check_candidate",
+           "check_candidates", "minimize"]

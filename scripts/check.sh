@@ -73,6 +73,39 @@ if [ "$RC_CLEAN" -ne 0 ]; then
     exit 1
 fi
 
+echo "== shrink smoke (seeded stale-read fixture) =="
+# the fixture plants a single stale read into a write-only history
+# (known minimum: ONE read pair); the minimizer must reach it and the
+# minimal history must still be INVALID on offline re-check
+SHRINK_STORE=$(mktemp -d)
+set +e
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --shrink \
+    --store "$SHRINK_STORE" tests/fixtures/shrink/stale_read.edn \
+    >/dev/null
+RC_SHRINK=$?
+set -e
+if [ "$RC_SHRINK" -ne 1 ]; then
+    echo "shrink seed fixture not INVALID (rc=$RC_SHRINK)"; exit 1
+fi
+MINIMAL=$(ls "$SHRINK_STORE"/shrink/*/minimal.edn 2>/dev/null | head -1)
+if [ -z "$MINIMAL" ]; then
+    echo "shrink wrote no minimal.edn"; exit 1
+fi
+OPS=$(grep -c ':process' "$MINIMAL")
+if [ "$OPS" -gt 2 ]; then
+    echo "shrink left $OPS ops (known minimum is 2)"; exit 1
+fi
+set +e
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --backend host \
+    "$MINIMAL" >/dev/null
+RC_MIN=$?
+set -e
+if [ "$RC_MIN" -ne 1 ]; then
+    echo "minimal.edn re-check rc=$RC_MIN (must still be INVALID)"
+    exit 1
+fi
+rm -rf "$SHRINK_STORE"
+
 echo "== verifier service smoke (CPU backend) =="
 # zombie baseline BEFORE the daemon runs: the post-shutdown check
 # below must catch NEW zombies (a reaped child can't show Z, so the
@@ -129,5 +162,5 @@ if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
 fi
 
 echo "OK: checker clean, ASan build clean, ct_pmux shutdown clean," \
-     "txn smoke caught the seeded cycle, verifier service shutdown" \
-     "clean"
+     "txn smoke caught the seeded cycle, shrink smoke reached the" \
+     "known minimum, verifier service shutdown clean"

@@ -1,0 +1,16 @@
+"""Seeded violation: one verdict dispatch per shrink candidate — the
+exact bug ``comdb2_tpu.shrink`` exists to avoid. Each
+``check_candidate`` call pays the ~100 ms tunnel round-trip, so a
+ddmin round over B candidates is B round-trips; the round's whole
+candidate set must ride ``shrink.verdicts.check_candidates`` (ONE
+``check_batch`` dispatch per pow2 shape bucket)."""
+
+from comdb2_tpu.shrink.verdicts import check_candidate
+
+
+def shrink_round(parent, masks, memo):
+    verdicts = []
+    for m in masks:
+        verdicts.append(check_candidate(       # <- per-item-dispatch
+            parent, m, memo, F=256))
+    return verdicts

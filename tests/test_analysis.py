@@ -34,6 +34,7 @@ FIXTURE_RULES = {
     "bad_nemesis_completion.py": "nemesis-info-completion",
     "bad_dispatch_loop.py": "per-item-dispatch",
     "bad_txn_dispatch_loop.py": "per-item-dispatch",
+    "bad_shrink_dispatch_loop.py": "per-item-dispatch",
     "bad_pack_per_op_loop.py": "per-op-host-loop",
     "bad_pallas_grid.py": "pallas-grid-steps",
     "bad_pallas_prefetch.py": "pallas-prefetch-smem",
