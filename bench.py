@@ -64,6 +64,25 @@ TREND_50K = {"r1_best": 85226.6, "r2_best": 80267.5,
 
 
 def main() -> None:
+    # every metric runs under the compile-surface guard: observed XLA
+    # lowerings must stay inside the static program inventory
+    # (PROGRAMS.md) — a recompile storm fails the bench instead of
+    # hiding inside a slow run. COMDB2_TPU_COMPILE_GUARD=0 keeps the
+    # report but drops the hard assert.
+    from comdb2_tpu.analysis.compile_surface import static_inventory
+    from comdb2_tpu.utils import compile_guard
+
+    inv = static_inventory()
+    g = compile_guard.CompileGuard().start()
+    try:
+        _main_metrics(guard=g, inventory=inv)
+    finally:
+        g.stop()
+    if compile_guard.enabled():
+        g.assert_closed(inv)
+
+
+def _main_metrics(guard=None, inventory=None) -> None:
     try:
         _bench_batch()
     except Exception as e:
@@ -89,7 +108,7 @@ def main() -> None:
             "error": f"{type(e).__name__}: {e}",
         }))
     try:
-        _run_bench()
+        _run_bench(guard=guard, inventory=inventory)
     except Exception as e:          # one JSON line, even on failure
         print(json.dumps({
             "metric": "linear_check_ops_per_s_50k",
@@ -341,7 +360,7 @@ def _run_bench_p10() -> None:
     }))
 
 
-def _run_bench() -> None:
+def _run_bench(guard=None, inventory=None) -> None:
     import jax
 
     from comdb2_tpu.utils.platform import enable_compile_cache
@@ -408,7 +427,7 @@ def _run_bench() -> None:
     ops_s = _median(n_ops, dts)
     trend = dict(TREND_50K, r5_median=round(ops_s, 1))
     d = segs.depth[segs.ok_proc >= 0]
-    print(json.dumps({
+    line = {
         "metric": "linear_check_ops_per_s_50k",
         "value": round(ops_s, 1),
         "unit": "ops/s",
@@ -418,7 +437,13 @@ def _run_bench() -> None:
         "mean_closure_depth": round(float(d.mean()), 3),
         "trend": trend,
         **_spread(n_ops, dts),
-    }))
+    }
+    if guard is not None:
+        # embedded here so the headline stays the LAST line (the
+        # last-line parser contract) while still carrying the guard's
+        # verdict over every metric that ran before it
+        line["compile_guard"] = guard.summary(inventory)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -22,6 +22,15 @@ cooperating passes:
   from the fuzz script or the driver), and the engine entry points are
   abstractly traced per bucket to flag duplicated sub-jaxprs under
   ``cond`` branches (the CPU compile-time explosion of round 3).
+- :mod:`.compile_surface` — pass 4, the compile-surface prover: the
+  static program inventory (service buckets, ``check_batch`` floors,
+  shrink/txn pow2 buckets, ``spec_for`` tiers) enumerated as the
+  ``PROGRAMS.md`` artifact, eval_shape ladder witnesses, and the
+  interprocedural ``unbucketed-dispatch-site`` rule. The runtime half
+  — observed-compile capture and the subset assertion — is
+  :mod:`comdb2_tpu.utils.compile_guard`.
+- :func:`audit_suppressions` — the ``stale-suppression`` rule: a
+  marker that no longer trips its rule is itself a finding.
 
 Per-line suppression: append ``# analysis: ignore[rule-id]`` (or a
 blanket ``# analysis: ignore``) to the flagged line. Each rule's
@@ -96,43 +105,214 @@ def collect_files(root: Optional[str] = None) -> List[str]:
     return out
 
 
-def run_paths(paths: Iterable[str]) -> List[Finding]:
-    """Run every file-level pass (lint + budget AST + jaxpr AST) over
-    explicit paths — the mode seeded-violation fixtures use."""
-    from . import jaxpr_audit, lint, pallas_budget
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _markers(source: str):
+    """``(lineno, rules-or-None)`` per ``analysis: ignore`` marker in
+    REAL comments (tokenize — marker text inside string literals is
+    not a marker; ``suppressed`` string-matches at enforcement time,
+    but the stale audit must not flag prose)."""
+    import io
+    import tokenize
+
+    out = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT \
+                    or "analysis: ignore" not in tok.string:
+                continue
+            rest = tok.string.split("analysis: ignore", 1)[1]
+            if rest.startswith("["):
+                inside = rest[1:rest.index("]")] if "]" in rest else ""
+                rules = tuple(r.strip() for r in inside.split(",")
+                              if r.strip())
+            else:
+                rules = None                 # blanket marker
+            out.append((tok.start[0], rules))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass                                 # lint owns syntax errors
+    return out
+
+
+def audit_suppressions(paths: Iterable[str],
+                       surface_raw: Optional[List[Finding]] = None
+                       ) -> List[Finding]:
+    """The ``stale-suppression`` rule: an ``# analysis: ignore[...]``
+    marker on a line that no longer trips that rule is itself a
+    finding — suppressions must not rot silently. Every file-level
+    pass contributes its RAW findings (suppression off), so a marker
+    is live iff some raw finding of its rule id lands on its line.
+    Stale-suppression findings are deliberately NOT suppressible
+    (a blanket marker would otherwise vouch for itself).
+
+    ``surface_raw``: pre-computed raw ``unbucketed-dispatch-site``
+    findings — the repo-staged runner passes the compile-surface
+    stage's own raw scan so the interprocedural call graph is built
+    once per run, not twice."""
+    from . import compile_surface, jaxpr_audit, lint, pallas_budget
+
+    paths = [p for p in paths if os.path.exists(p)]
+    raw: dict = {p: [] for p in paths}
+    srcs: dict = {}
+    marked: List[str] = []
+    for p in paths:
+        try:
+            srcs[p] = _read(p)
+        except OSError:
+            continue
+        if "analysis: ignore" in srcs[p]:
+            marked.append(p)
+    # only marker-bearing files can produce stale-suppression
+    # findings, so only they need the raw per-file re-scans (the
+    # whole-repo re-scan measured 3 s against 1.2 s for every other
+    # AST pass combined)
+    for p in marked:
+        raw[p] += lint.lint_file(p, srcs[p],
+                                 apply_suppressions=False)
+        raw[p] += pallas_budget.scan_file(p, srcs[p],
+                                          apply_suppressions=False)
+        raw[p] += jaxpr_audit.scan_file(p, srcs[p],
+                                        apply_suppressions=False)
+    if marked:
+        if surface_raw is None:
+            # the full path set: the interprocedural rule needs the
+            # whole call graph even when only a few files carry
+            # markers
+            surface_raw = compile_surface.scan_files(
+                paths, apply_suppressions=False)
+        for f in surface_raw:
+            raw.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for p in marked:
+        if p not in srcs:
+            continue
+        hits = {(f.line, f.rule) for f in raw[p]}
+        lines_hit = {f.line for f in raw[p]}
+        for ln, rules in _markers(srcs[p]):
+            if rules is None:
+                if ln not in lines_hit:
+                    out.append(Finding(
+                        "stale-suppression", p, ln,
+                        "blanket 'analysis: ignore' on a line no "
+                        "rule trips — remove the marker (stale "
+                        "suppressions hide future regressions)"))
+                continue
+            for r in rules:
+                if (ln, r) not in hits:
+                    out.append(Finding(
+                        "stale-suppression", p, ln,
+                        f"suppression for '{r}' no longer trips on "
+                        "this line — remove the marker (stale "
+                        "suppressions hide future regressions)"))
+    return out
+
+
+def _staged(stages) -> List[tuple]:
+    """Run ``(name, thunk)`` stages, timing each; returns
+    ``[(name, findings, seconds), ...]``."""
+    import time
+
+    out = []
+    for name, thunk in stages:
+        t0 = time.monotonic()
+        findings = thunk()
+        out.append((name, findings, time.monotonic() - t0))
+    return out
+
+
+def run_paths_staged(paths: Iterable[str]) -> List[tuple]:
+    """Every file-level pass over explicit paths — the mode the
+    seeded-violation fixtures use — as timed stages."""
+    from . import compile_surface, jaxpr_audit, lint, pallas_budget
 
     paths = list(paths)
-    findings: List[Finding] = []
-    for p in paths:
-        findings += lint.lint_file(p)
-    findings += pallas_budget.scan_files(paths)
-    findings += jaxpr_audit.scan_files(paths)
-    return findings
+    return _staged([
+        ("lint", lambda: lint.lint_files(paths)),
+        ("pallas-budget", lambda: pallas_budget.scan_files(paths)),
+        ("jaxpr-audit", lambda: jaxpr_audit.scan_files(paths)),
+        ("compile-surface", lambda: compile_surface.scan_files(paths)),
+        ("suppression-audit", lambda: audit_suppressions(paths)),
+    ])
+
+
+def run_repo_staged(root: Optional[str] = None, *,
+                    trace: bool = True) -> List[tuple]:
+    """The full repo-wide run as timed stages: lint over the scan
+    roots; the production Pallas budget table; the jaxpr recompile
+    audit (bucket-closure scan of the fuzz script and the driver,
+    plus — with ``trace`` — abstract traces of the engine entry
+    points); the compile-surface prover (pass 4: unbucketed-dispatch
+    scan of the production modules + eval_shape ladder witnesses);
+    and the stale-suppression audit."""
+    from . import compile_surface, jaxpr_audit, lint, pallas_budget
+
+    root = root or repo_root()
+    files = collect_files(root)
+    # pass 4's dispatch-site scan covers the production surface
+    # (package + scripts); tests probe odd shapes on purpose
+    prod = [p for p in files
+            if "tests" not in p.replace("\\", "/").split("/")]
+
+    def jaxpr_stage():
+        out = jaxpr_audit.scan_files(
+            [os.path.join(root, "scripts", "fuzz_pallas_seg.py"),
+             os.path.join(root, "comdb2_tpu", "checker", "linear.py")])
+        out += jaxpr_audit.check_bucket_closure()
+        if trace:
+            out += jaxpr_audit.trace_entry_points()
+        return out
+
+    surface_raw: List[Finding] = []
+
+    def surface_stage():
+        # raw once: the stage filters suppressions itself and hands
+        # the raw findings to the audit (one call-graph build per run)
+        raw = compile_surface.scan_files(prod,
+                                         apply_suppressions=False)
+        surface_raw.extend(raw)
+        lines_of: dict = {}
+        out = []
+        for f in raw:
+            if f.path not in lines_of:
+                try:
+                    lines_of[f.path] = _read(f.path).splitlines()
+                except OSError:
+                    lines_of[f.path] = []
+            if not suppressed(lines_of[f.path], f.line, f.rule):
+                out.append(f)
+        if trace:
+            out += compile_surface.trace_witnesses()
+        return out
+
+    return _staged([
+        ("lint", lambda: lint.lint_files(files)),
+        ("pallas-budget",
+         lambda: pallas_budget.scan_files(files)
+         + pallas_budget.check_production()),
+        ("jaxpr-audit", jaxpr_stage),
+        ("compile-surface", surface_stage),
+        ("suppression-audit",
+         lambda: audit_suppressions(files, surface_raw=surface_raw)),
+    ])
+
+
+def run_paths(paths: Iterable[str]) -> List[Finding]:
+    """Flat view of :func:`run_paths_staged`."""
+    return [f for _, fs, _ in run_paths_staged(paths) for f in fs]
 
 
 def run_repo(root: Optional[str] = None, *,
              trace: bool = True) -> List[Finding]:
-    """The full repo-wide run: lint over the scan roots, the
-    production Pallas budget table, and the jaxpr recompile audit
-    (bucket-closure scan of the fuzz script and the driver, plus —
-    with ``trace`` — abstract traces of the engine entry points)."""
-    from . import jaxpr_audit, lint, pallas_budget
-
-    root = root or repo_root()
-    files = collect_files(root)
-    findings: List[Finding] = []
-    for p in files:
-        findings += lint.lint_file(p)
-    findings += pallas_budget.scan_files(files)
-    findings += pallas_budget.check_production()
-    findings += jaxpr_audit.scan_files(
-        [os.path.join(root, "scripts", "fuzz_pallas_seg.py"),
-         os.path.join(root, "comdb2_tpu", "checker", "linear.py")])
-    findings += jaxpr_audit.check_bucket_closure()
-    if trace:
-        findings += jaxpr_audit.trace_entry_points()
-    return findings
+    """Flat view of :func:`run_repo_staged`."""
+    return [f for _, fs, _ in run_repo_staged(root, trace=trace)
+            for f in fs]
 
 
-__all__ = ["Finding", "SCAN_ROOTS", "collect_files", "repo_root",
-           "run_paths", "run_repo", "suppressed"]
+__all__ = ["Finding", "SCAN_ROOTS", "audit_suppressions",
+           "collect_files", "repo_root", "run_paths",
+           "run_paths_staged", "run_repo", "run_repo_staged",
+           "suppressed"]

@@ -1,12 +1,16 @@
 """CLI: ``python -m comdb2_tpu.analysis [paths...]``.
 
 With no paths: the full repo-wide run (lint over comdb2_tpu/, scripts/
-and tests/; production Pallas budgets; jaxpr recompile audit). With
-explicit paths: the file-level passes only — the mode the seeded
-violation fixtures (tests/fixtures/analysis/) use.
+and tests/; production Pallas budgets; jaxpr recompile audit; the
+compile-surface prover; the stale-suppression audit). With explicit
+paths: the file-level passes only — the mode the seeded violation
+fixtures (tests/fixtures/analysis/) use.
 
-Exits non-zero when any finding survives suppression; each finding
-prints as ``rule-id path:line message``.
+Exits non-zero when any finding survives suppression — including when
+``--json`` writes the findings artifact (the artifact records the
+failure, it never absorbs it). Each finding prints as ``rule-id
+path:line message``; per-pass wall times go to stderr so a slow pass
+is visible instead of smeared into one opaque run time.
 """
 
 from __future__ import annotations
@@ -16,7 +20,7 @@ import json
 import sys
 from typing import List
 
-from . import Finding, run_paths, run_repo
+from . import Finding, run_paths_staged, run_repo_staged
 
 
 def main(argv=None) -> int:
@@ -26,12 +30,17 @@ def main(argv=None) -> int:
     p.add_argument("paths", nargs="*",
                    help="explicit files to check (default: whole repo)")
     p.add_argument("--no-trace", action="store_true",
-                   help="skip the jaxpr abstract-trace stage")
+                   help="skip the jaxpr/eval_shape abstract-trace "
+                        "stages")
     p.add_argument("--budget-table", metavar="PATH",
                    help="write the checked Pallas budget table "
                         "artifact (markdown) and continue")
+    p.add_argument("--programs", metavar="PATH",
+                   help="write the compile-surface program inventory "
+                        "artifact (PROGRAMS.md) and continue")
     p.add_argument("--json", metavar="PATH", dest="json_out",
-                   help="also write findings as JSON")
+                   help="also write findings as JSON (does not change "
+                        "the exit code)")
     args = p.parse_args(argv)
 
     if args.budget_table:
@@ -41,14 +50,24 @@ def main(argv=None) -> int:
             fh.write(pallas_budget.budget_table())
         print(f"budget table written: {args.budget_table}")
 
-    findings: List[Finding]
-    if args.paths:
-        findings = run_paths(args.paths)
-    else:
-        findings = run_repo(trace=not args.no_trace)
+    if args.programs:
+        from . import compile_surface
 
+        with open(args.programs, "w") as fh:
+            fh.write(compile_surface.render_programs())
+        print(f"program inventory written: {args.programs}")
+
+    if args.paths:
+        stages = run_paths_staged(args.paths)
+    else:
+        stages = run_repo_staged(trace=not args.no_trace)
+
+    findings: List[Finding] = [f for _, fs, _ in stages for f in fs]
     for f in findings:
         print(f.format())
+    for name, fs, secs in stages:
+        print(f"pass {name}: {len(fs)} finding(s) in {secs:.2f}s",
+              file=sys.stderr)
     if args.json_out:
         with open(args.json_out, "w") as fh:
             json.dump([f.__dict__ for f in findings], fh, indent=1)

@@ -1,0 +1,869 @@
+"""Pass 4 — the compile-surface prover.
+
+The framework's serving discipline is that every jit/Pallas-visible
+shape comes from a CLOSED, pow2-bucketed program set: the service
+buckets admission (:mod:`comdb2_tpu.service.bucketing`), ``check_batch``
+floors its segment/table axes, the shrink minimizer groups candidates
+into pow2 kept-op buckets, the txn closure pads N pow2, and the fused
+kernel compiles one Mosaic program per :class:`SegKernelSpec`. That
+discipline existed only as prose and convention; the known failure mode
+(per-seed shapes compiling one program per seed until LLVM OOMs) is
+exactly what the multi-chip and continuous-batching roadmap items would
+multiply. This pass turns "the program set seemed closed" into a
+machine-checked statement, in three parts:
+
+- :func:`static_inventory` — walk the DECLARED ladders (service bucket
+  axes from :class:`ServiceLimits`, the ``check_batch`` shape floors,
+  shrink pow2 kept-op buckets, txn pow2-N buckets, every ``spec_for``
+  tier reachable from the production bucket ladder) and enumerate the
+  finite set of compilable programs per dispatch site.
+- :func:`trace_witnesses` — abstractly evaluate one witness rung per
+  site through the REAL entry point via ``jax.eval_shape`` over
+  ``ShapeDtypeStruct`` ladders (builds the jaxpr only — no XLA
+  compile, no device): a ladder whose shapes no longer trace is a
+  finding, not a 40 s compile failure.
+- :func:`scan_files` — the ``unbucketed-dispatch-site`` rule: an AST
+  scan of the batch/serving dispatch sites whose shape arguments must
+  come from a declared ladder. INTERPROCEDURAL: a shape argument that
+  is a function parameter is chased through the call graph to every
+  call site, so a raw ``memo.n_states`` laundered through a helper is
+  still caught. Only PROVABLY-raw values are flagged (``len(...)``,
+  ``.shape[...]``, raw memo-count attributes, non-pow2 literals);
+  values whose provenance is out of AST reach stay silent — the
+  runtime guard (:mod:`comdb2_tpu.utils.compile_guard`) is the
+  backstop for those.
+
+``render_programs`` emits the inventory as the checked-in
+``PROGRAMS.md`` artifact (same drift contract as the budget table:
+tier-1 regenerates it and any diff is a failure). The runtime half —
+observed-compile capture and the subset assertion — lives in
+:mod:`comdb2_tpu.utils.compile_guard`; :meth:`Inventory.offenders`
+is the bridge between the two.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import Finding, suppressed
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _pow2_count(lo: int, hi: int) -> int:
+    return hi.bit_length() - lo.bit_length() + 1
+
+
+# --- axis / site model ------------------------------------------------------
+
+@dataclass(frozen=True)
+class Axis:
+    """One declared integer axis of a traced argument shape."""
+
+    name: str
+    kind: str                 # pow2 | enum | linear
+    lo: int = 1
+    hi: int = 1 << 20
+    values: Tuple[int, ...] = ()
+
+    def admits(self, v: int) -> bool:
+        if self.kind == "enum":
+            return v in self.values
+        if self.kind == "pow2":
+            return _is_pow2(v) and self.lo <= v <= self.hi
+        if self.kind == "linear":
+            return self.lo <= v <= self.hi
+        raise ValueError(self.kind)
+
+    @property
+    def cardinality(self) -> Optional[int]:
+        """Distinct admitted values, or None for linear axes (those
+        compile one program per value BY DESIGN — chunked scans)."""
+        if self.kind == "enum":
+            return len(set(self.values))
+        if self.kind == "pow2":
+            return _pow2_count(self.lo, self.hi)
+        return None
+
+    def describe(self) -> str:
+        if self.kind == "enum":
+            return "{" + ",".join(str(v)
+                                  for v in sorted(set(self.values))) + "}"
+        if self.kind == "pow2":
+            return f"pow2 {self.lo}..{self.hi}"
+        return f"1..{self.hi} (linear: one program per value)"
+
+
+@dataclass(frozen=True)
+class Site:
+    """One dispatch site: the jit names it compiles under plus the
+    declared shape templates its programs are drawn from.
+
+    ``templates``: tuple of argument-list templates; each template is
+    a tuple of per-argument Axis tuples (scalar argument = empty
+    tuple). A record matches when ANY template fits rank-for-rank and
+    every dim is admitted. ``open_site=True`` matches any shapes —
+    per-item programs by design (the single-history driver).
+    """
+
+    key: str
+    jit_names: Tuple[str, ...]
+    note: str
+    templates: Tuple[Tuple[Tuple[Axis, ...], ...], ...] = ()
+    open_site: bool = False    # per-item shapes by design (driver)
+    axes_doc: Tuple[Axis, ...] = ()   # the distinct axes, for the doc
+    bound_note: str = ""
+
+    def matches(self, shapes: Sequence[Tuple[int, ...]]) -> bool:
+        if self.open_site:
+            return True
+        for tmpl in self.templates:
+            if len(tmpl) != len(shapes):
+                continue
+            ok = True
+            for axes, shape in zip(tmpl, shapes):
+                if len(axes) != len(shape) or \
+                        any(not ax.admits(d)
+                            for ax, d in zip(axes, shape)):
+                    ok = False
+                    break
+            if ok:
+                return True
+        return False
+
+    def program_bound(self) -> str:
+        """Human-readable bound on distinct programs this site can
+        compile (linear axes annotated, not multiplied in)."""
+        if self.bound_note:
+            return self.bound_note
+        total = 1
+        linear = []
+        for ax in self.axes_doc:
+            c = ax.cardinality
+            if c is None:
+                linear.append(ax.name)
+            else:
+                total *= c
+        out = f"<= {total}"
+        if linear:
+            out += " x one per value of " + ",".join(linear)
+        return out
+
+
+@dataclass(frozen=True)
+class Inventory:
+    """The full static program inventory + the infra allowlist.
+
+    ``infra_names``: jit names of O(1)-shaped host-glue programs
+    (scalar converts, iota builders) that ride along with any
+    workload; they are name-allowlisted, not shape-constrained — the
+    prover's guarantee covers the ENGINE surface."""
+
+    sites: Tuple[Site, ...]
+    infra_names: frozenset
+
+    def site_for(self, name: str) -> Optional[Site]:
+        for s in self.sites:
+            if name in s.jit_names:
+                return s
+        return None
+
+    def matches(self, record) -> bool:
+        """record: any object with ``.name`` and ``.shapes``."""
+        site = self.site_for(record.name)
+        if site is not None:
+            return site.matches(record.shapes)
+        return record.name in self.infra_names
+
+    def offenders(self, records) -> list:
+        """The records OUTSIDE the declared compile surface."""
+        return [r for r in records if not self.matches(r)]
+
+
+# --- the declared ladders ---------------------------------------------------
+
+def _ladders() -> dict:
+    """Every closed value set, derived from the DECLARED constants —
+    never from observed traffic (the whole point is that traffic can't
+    widen the set)."""
+    from ..service.bucketing import ServiceLimits
+    from ..shrink.verdicts import MAX_BATCH, MIN_BUCKET
+    from ..txn.edges import TXN_N_FLOOR
+    from ..utils import next_pow2
+    from .pallas_budget import PRODUCTION_BUCKETS
+    from .pallas_budget import production_tiers
+
+    lim = ServiceLimits()
+    specs = [spec for _, _, _, spec in production_tiers()]
+    return {
+        "limits": lim,
+        "fuzz_buckets": tuple(PRODUCTION_BUCKETS),
+        "specs": specs,
+        "kernel_chunks": tuple(sorted({s.chunk for s in specs})),
+        "kernel_widths": tuple(sorted({2 + 2 * s.K for s in specs})),
+        "kernel_rows": tuple(sorted({s.rows for s in specs})),
+        "kernel_table_rows": tuple(sorted({s.table_rows_pad
+                                           for s in specs})),
+        "kernel_words": tuple(sorted({s.n_words for s in specs})),
+        "service_n_pad": (16, next_pow2(lim.max_ops)),
+        "service_S": (8, next_pow2(lim.max_segments)),
+        "service_K": (2, next_pow2(lim.max_invokes_per_seg)),
+        "service_P": (2, next_pow2(lim.max_processes)),
+        "txn_N": (TXN_N_FLOOR, 1 << 16),
+        "shrink_bucket": (MIN_BUCKET, next_pow2(lim.max_ops)),
+        "shrink_B": (1, MAX_BATCH),
+        "batch_B": (1, 1 << 12),
+        "memo_dim": (1, 1 << 20),
+    }
+
+
+#: host-glue jit names observed on the engine workloads: scalar dtype
+#: converts and tiny index builders XLA compiles once per (dtype,
+#: rank-0/1 shape). Name-allowlisted (shapes unconstrained) — the
+#: closure guarantee covers the engine sites above. ONLY jax-internal
+#: primitive-wrapper names belong here: a generic user-function name
+#: (e.g. "fn", "run" without its site) would exempt arbitrary engine
+#: code from the guarantee.
+INFRA_NAMES = frozenset({
+    "convert_element_type", "_threefry_seed", "_uint32",
+    "iota", "arange", "broadcast_in_dim", "reshape", "concatenate",
+    "_power", "true_divide", "floor_divide", "remainder",
+})
+
+
+def static_inventory() -> Inventory:
+    """Build the declared compile surface (pure host work — imports
+    the ladder constants, never jax)."""
+    L = _ladders()
+    lane = Axis("lane", "enum", values=(128,))
+    one = Axis("one", "enum", values=(1,))
+    four = Axis("planes", "enum", values=(4,))
+
+    memo = Axis("n_states/n_transitions", "pow2", *L["memo_dim"])
+    S = Axis("S", "pow2", 1, L["service_S"][1] << 4)
+    K = Axis("K", "pow2", 1, 8)
+    B = Axis("B", "pow2", *L["batch_B"])
+    n_pad = Axis("n_pad", "pow2", 1, L["service_n_pad"][1] << 4)
+
+    xla_batch_seg = (
+        (memo, memo), (S, B, K), (S, B, K), (S, B), (S,))
+    xla_batch_vmap = (
+        (memo, memo), (B, n_pad), (B, n_pad), (B, n_pad))
+
+    n_chunks = Axis("n_chunks", "linear", 1, 1 << 16)
+    chunk = Axis("chunk", "enum", values=L["kernel_chunks"] + (16,))
+    width = Axis("2+2K", "enum", values=L["kernel_widths"])
+    rows = Axis("rows", "enum", values=L["kernel_rows"])
+    table_rows = Axis("table_rows", "enum",
+                      values=L["kernel_table_rows"])
+    b_pad = Axis("b_pad", "pow2", 8, 2048)
+    run_templates = []
+    for W in L["kernel_words"]:
+        run_templates.append(
+            ((n_chunks, chunk, width),)
+            + ((rows, lane),) * W
+            + ((one, lane), (b_pad, lane), (table_rows, lane), ()))
+
+    N = Axis("N", "pow2", *L["txn_N"])
+    N8 = Axis("N/8", "pow2", L["txn_N"][0] // 8, L["txn_N"][1] // 8)
+    txn_B = Axis("B", "pow2", 1, 1 << 12)
+
+    sites = (
+        Site(
+            key="pallas-stream-scan",
+            jit_names=("run",),
+            note="fused-kernel chunk scan (checker/pallas_seg._scan_fn)"
+                 ": one Mosaic program per (SegKernelSpec, b_pad, "
+                 "stream); specs are drawn from the production tier "
+                 "table (pallas_budget.production_tiers), b_pad is the "
+                 "pow2 results-buffer bucket, chunk count is the "
+                 "chunked-engine scan length (linear by design)",
+            templates=tuple(run_templates),
+            axes_doc=(chunk, width, rows, table_rows, b_pad,
+                      Axis("n_words", "enum",
+                           values=L["kernel_words"]), n_chunks),
+        ),
+        Site(
+            key="xla-batch-engines",
+            jit_names=("check_device_keys", "check_device_flat",
+                       "check_device_seg_batch"),
+            note="batched XLA engines (checker/linear_jax): segment "
+                 "tensors (S, B, K) with every axis pow2 "
+                 "(segment_batch pads, service buckets floor), memo "
+                 "table dims pow2 (pad_succ)",
+            templates=(xla_batch_seg,),
+            axes_doc=(memo, S, B, K),
+        ),
+        Site(
+            key="xla-batch-vmap",
+            jit_names=("check_device_batch",),
+            note="vmap fallback engine: dense step streams (B, n_pad), "
+                 "both axes pow2 (make_stream pads, service n_pad "
+                 "bucket)",
+            templates=(xla_batch_vmap,),
+            axes_doc=(memo, B, n_pad),
+        ),
+        Site(
+            key="xla-driver-engines",
+            jit_names=("check_device", "check_device_seg",
+                       "check_device_seg_chunk", "check_device_seg2",
+                       "check_device_seg2_chunk", "pending_histogram"),
+            note="single-history adaptive driver (checker/linear.py "
+                 "and bench.py's 50k control): compiles per history "
+                 "shape BY DESIGN — an OPEN site, outside the closure "
+                 "guarantee. The per-item-dispatch lint rule keeps "
+                 "serving traffic off this path; the closed serving "
+                 "surface is the batch/stream/txn sites above",
+            open_site=True,
+            bound_note="open (one program per history shape; "
+                       "single-history driver path only)",
+        ),
+        Site(
+            key="txn-closure",
+            jit_names=("closure_diag_kernel",),
+            note="txn matrix-closure engine (txn/closure_jax): packed "
+                 "adjacency planes (4, N, N/8) or (B, 4, N, N/8); N "
+                 "pow2 >= TXN_N_FLOOR (service cap 4096, offline "
+                 "shrink may go wider), B pow2 (service pads)",
+            templates=(((four, N, N8),), ((txn_B, four, N, N8),)),
+            axes_doc=(N, txn_B),
+        ),
+    )
+    return Inventory(sites=sites, infra_names=INFRA_NAMES)
+
+
+# --- eval_shape witnesses ---------------------------------------------------
+
+def _witness_specs():
+    """(site_key, describe, thunk) triples; each thunk builds the
+    ShapeDtypeStruct args and runs ``jax.eval_shape`` on the REAL
+    entry point (abstract trace only — no compile, no device)."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    i32 = np.int32
+
+    def st(shape, dt=i32):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    def kernel_witness():
+        from ..checker import pallas_seg as PS
+
+        spec = PS.spec_for(8, 32, 4, 2)
+        assert spec is not None
+        run = PS._scan_fn(spec, 8, True)
+        W = spec.n_words
+        return jax.eval_shape(
+            run, st((2, spec.chunk, 2 + 2 * spec.K)),
+            tuple(st((spec.rows, 128)) for _ in range(W)),
+            st((1, 128)), st((8, 128)),
+            st((spec.table_rows_pad, 128)), 32)
+
+    def keys_witness():
+        from ..checker import linear_jax as LJ
+
+        fn = functools.partial(LJ.check_device_keys, B=4, F=64, P=2,
+                               n_states=16, n_transitions=16)
+        return jax.eval_shape(fn, st((16, 16)), st((8, 4, 2)),
+                              st((8, 4, 2)), st((8, 4)), st((8,)))
+
+    def flat_witness():
+        from ..checker import linear_jax as LJ
+
+        fn = functools.partial(LJ.check_device_flat, B=4, F=64, P=2,
+                               n_states=16, n_transitions=16)
+        return jax.eval_shape(fn, st((16, 16)), st((8, 4, 2)),
+                              st((8, 4, 2)), st((8, 4)), st((8,)))
+
+    def closure_witness():
+        from ..txn import closure_jax as CJ
+
+        return jax.eval_shape(CJ._jitted(16),
+                              st((4, 16, 2), np.uint8))
+
+    return (
+        ("pallas-stream-scan",
+         "spec_for(8,32,P=4,K=2), 2 chunks, b_pad=8", kernel_witness),
+        ("xla-batch-engines",
+         "check_device_keys at (ns,nt)=(16,16) S=8 B=4 K=2",
+         keys_witness),
+        ("xla-batch-engines",
+         "check_device_flat at (ns,nt)=(16,16) S=8 B=4 K=2",
+         flat_witness),
+        ("txn-closure", "closure bucket N=16", closure_witness),
+    )
+
+
+def trace_witnesses() -> List[Finding]:
+    """Abstractly trace one witness rung per site; a ladder whose
+    shapes no longer trace is a ``compile-surface-trace`` finding."""
+    from .jaxpr_audit import _force_cpu
+
+    if not _force_cpu():
+        return [Finding(
+            "compile-surface-trace", __file__, 0,
+            "a non-CPU jax backend was initialized before the prover "
+            "could pin the platform — run with JAX_PLATFORMS=cpu")]
+    out: List[Finding] = []
+    for key, desc, thunk in _witness_specs():
+        try:
+            thunk()
+        except Exception as e:          # a broken ladder IS a finding
+            out.append(Finding(
+                "compile-surface-trace", __file__, 0,
+                f"site {key}: witness '{desc}' failed to trace: "
+                f"{type(e).__name__}: {e}"))
+    return out
+
+
+def witness_table() -> List[Tuple[str, str, str]]:
+    """(site, witness, out-shapes) rows for the artifact. Raises
+    (rather than silently emitting an empty table) when the platform
+    can't be pinned — a PROGRAMS.md missing its witness rows would
+    fail the golden test as unexplained drift."""
+    import jax
+
+    from .jaxpr_audit import _force_cpu
+
+    if not _force_cpu():
+        raise RuntimeError(
+            "cannot regenerate the witness table: a non-CPU jax "
+            "backend was initialized before the prover could pin the "
+            "platform — rerun with JAX_PLATFORMS=cpu in a fresh "
+            "process")
+    rows = []
+    for key, desc, thunk in _witness_specs():
+        try:
+            out = thunk()
+            shapes = jax.tree.map(lambda x: tuple(x.shape), out)
+            rows.append((key, desc, str(shapes)))
+        except Exception as e:
+            rows.append((key, desc, f"TRACE FAILED: {type(e).__name__}"))
+    return rows
+
+
+# --- the PROGRAMS.md artifact -----------------------------------------------
+
+def render_programs() -> str:
+    """The compile-surface inventory as a deterministic markdown
+    artifact (the drift contract of ``PROGRAMS.md``: tier-1
+    regenerates this and any diff is a failure)."""
+    L = _ladders()
+    inv = static_inventory()
+    lim = L["limits"]
+    out = [
+        "# Compile-surface inventory",
+        "",
+        "Generated by `python -m comdb2_tpu.analysis --programs "
+        "PROGRAMS.md`; checked by `tests/test_compile_surface.py`",
+        "(drift = failure, same contract as the budget table). Every",
+        "program XLA or Mosaic may compile for the serving surface is",
+        "drawn from the ladders below; the runtime guard",
+        "(`comdb2_tpu.utils.compile_guard`) asserts observed compiles",
+        "stay a subset.",
+        "",
+        "## Declared ladders",
+        "",
+        "| ladder | values | source |",
+        "|---|---|---|",
+        f"| fuzz kernel buckets | {list(L['fuzz_buckets'])} | "
+        "`analysis.pallas_budget.PRODUCTION_BUCKETS` |",
+        f"| service n_pad | pow2 {L['service_n_pad'][0]}.."
+        f"{L['service_n_pad'][1]} | `ServiceLimits.max_ops="
+        f"{lim.max_ops}` |",
+        f"| service S | pow2 {L['service_S'][0]}..{L['service_S'][1]}"
+        f" | `ServiceLimits.max_segments={lim.max_segments}` |",
+        f"| service K | pow2 {L['service_K'][0]}..{L['service_K'][1]}"
+        f" | `ServiceLimits.max_invokes_per_seg="
+        f"{lim.max_invokes_per_seg}` |",
+        f"| service P | pow2 {L['service_P'][0]}..{L['service_P'][1]}"
+        f" | `ServiceLimits.max_processes={lim.max_processes}` |",
+        f"| service P_eff | even 2..{lim.max_slots} | "
+        f"`ServiceLimits.max_slots={lim.max_slots}` |",
+        f"| txn closure N | pow2 {L['txn_N'][0]}..{L['txn_N'][1]} | "
+        f"`txn.edges.TXN_N_FLOOR`, `ServiceLimits.max_txns="
+        f"{lim.max_txns}` (service cap; offline shrink may go wider) |",
+        f"| shrink kept-op buckets | pow2 {L['shrink_bucket'][0]}.."
+        f"{L['shrink_bucket'][1]} | `shrink.verdicts.MIN_BUCKET` |",
+        f"| shrink batch B | pow2 {L['shrink_B'][0]}.."
+        f"{L['shrink_B'][1]} | `shrink.verdicts.MAX_BATCH` |",
+        f"| memo table dims | pow2 {L['memo_dim'][0]}.."
+        f"{L['memo_dim'][1]} | `pad_succ(next_pow2(...))` at every "
+        "dispatch path |",
+        f"| kernel chunk | {list(L['kernel_chunks'])} (+16 interpret)"
+        " | `spec_for` SMEM bound per K |",
+        f"| kernel widths (2+2K) | {list(L['kernel_widths'])} | "
+        "K = 1..8 |",
+        f"| kernel buffer rows | {list(L['kernel_rows'])} | "
+        "(8,128)/(16,128) tiers |",
+        f"| kernel table rows | {list(L['kernel_table_rows'])} | "
+        "`table_rows_pad` buckets |",
+        "",
+        "## Dispatch sites",
+        "",
+    ]
+    for site in inv.sites:
+        out.append(f"### {site.key}")
+        out.append("")
+        out.append(f"- jit names: {', '.join(site.jit_names)}")
+        out.append(f"- {site.note}")
+        if site.axes_doc:
+            out.append("- axes: " + "; ".join(
+                f"{ax.name} in {ax.describe()}"
+                for ax in site.axes_doc))
+        out.append(f"- program bound: {site.program_bound()}")
+        out.append("")
+    nspecs = len(L["specs"])
+    out += [
+        "## Kernel spec tiers",
+        "",
+        f"{nspecs} distinct `SegKernelSpec` tiers are reachable from "
+        "the production bucket ladder x P(1..15) x K(1..8) — the full "
+        "per-tier budget table is the `--budget-table` artifact.",
+        "",
+        "## Abstract-trace witnesses (jax.eval_shape)",
+        "",
+        "| site | witness | out shapes |",
+        "|---|---|---|",
+    ]
+    for key, desc, shapes in witness_table():
+        out.append(f"| {key} | {desc} | {shapes} |")
+    out += [
+        "",
+        "## Infra allowlist",
+        "",
+        "Host-glue programs (scalar converts, index builders) are",
+        "name-allowlisted, not shape-constrained:",
+        "",
+        "`" + "`, `".join(sorted(INFRA_NAMES)) + "`",
+        "",
+    ]
+    return "\n".join(out) + ""
+
+
+# --- the unbucketed-dispatch-site AST rule ----------------------------------
+
+#: sinks: callee name -> shape-carrying argument spec. Deliberately the
+#: BATCH/SERVING surface only — the single-history driver's adaptive
+#: path passes exact sizes on purpose (spec_for/pad_succ bucket them
+#: downstream) and is declared an OPEN site in the runtime inventory.
+SHAPE_SINKS: Dict[str, dict] = {
+    "check_batch": {"kwargs": ("s_pad", "k_pad", "n_states_pad",
+                               "n_transitions_pad", "p_eff_pad")},
+    "check_batch_async": {"kwargs": ("s_pad", "k_pad",
+                                     "n_states_pad",
+                                     "n_transitions_pad",
+                                     "p_eff_pad")},
+    "segment_batch": {"kwargs": ("s_pad", "k_pad")},
+    "pack_batch": {"kwargs": ("n_pad",)},
+    "make_segments": {"kwargs": ("s_pad", "k_pad")},
+    "pad_succ": {"kwargs": ("s_pad", "t_pad"), "pos": (1, 2)},
+    "check_device_keys": {"kwargs": ("n_states", "n_transitions")},
+    "check_device_flat": {"kwargs": ("n_states", "n_transitions")},
+    "check_device_seg_batch": {"kwargs": ("n_states",
+                                          "n_transitions")},
+    "check_device_batch": {"kwargs": ("n_states", "n_transitions")},
+    "check_device_pallas_stream": {"kwargs": ("n_states",
+                                              "n_transitions")},
+}
+
+#: callables that PRODUCE bucketed values
+SANCTIONERS = {"next_pow2", "_next_pow2", "bucket_of", "padded"}
+
+#: attribute reads that are raw per-history counts (memo tables)
+RAW_ATTRS = {"n_states", "n_transitions"}
+
+#: attribute reads that are ladder-derived by construction
+#: (service Bucket / TxnBucket fields)
+BUCKETED_ATTRS = {"S", "K", "P", "P_eff", "n_pad", "N"}
+
+_MAX_DEPTH = 5
+
+BUCKETED, RAW, UNKNOWN = 0, 1, 2
+
+
+@dataclass
+class _FileInfo:
+    path: str
+    tree: ast.Module
+    lines: List[str]
+    funcs: Dict[str, ast.AST] = field(default_factory=dict)
+    consts: Dict[str, int] = field(default_factory=dict)
+
+
+class _Graph:
+    """Cross-file call graph over the scanned set: function defs by
+    name (chased only when unambiguous or same-file) and call sites by
+    callee name."""
+
+    def __init__(self, infos: List[_FileInfo]):
+        self.infos = infos
+        self.defs: Dict[str, List[Tuple[_FileInfo, ast.AST]]] = {}
+        # callee name -> [(info, call node, enclosing funcdef | None)]
+        self.calls: Dict[str, List[tuple]] = {}
+        for info in infos:
+            self._index(info)
+
+    @staticmethod
+    def _callee(call: ast.Call) -> str:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        return ""
+
+    def _index(self, info: _FileInfo) -> None:
+        from .pallas_budget import _module_consts
+
+        info.consts = _module_consts(info.tree)
+        stack: List[ast.AST] = []
+
+        def walk(node, fn):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                info.funcs.setdefault(node.name, node)
+                self.defs.setdefault(node.name, []).append((info, node))
+                fn = node
+            if isinstance(node, ast.Call):
+                name = self._callee(node)
+                if name:
+                    self.calls.setdefault(name, []).append(
+                        (info, node, fn))
+            for child in ast.iter_child_nodes(node):
+                walk(child, fn)
+
+        walk(info.tree, None)
+
+    def def_of(self, name: str,
+               prefer: _FileInfo) -> Optional[Tuple[_FileInfo, ast.AST]]:
+        cands = self.defs.get(name, [])
+        same = [c for c in cands if c[0] is prefer]
+        if same:
+            return same[0]
+        if len(cands) == 1:          # unambiguous across the repo
+            return cands[0]
+        return None                  # ambiguous: stay silent
+
+
+def _classify(expr: ast.AST, info: _FileInfo,
+              fn: Optional[ast.AST], graph: _Graph,
+              depth: int, visited: set):
+    """(verdict, detail, anchor_line) for a shape-valued expression.
+    RAW means PROVABLY unbucketed; UNKNOWN means out of AST reach
+    (silent — the runtime guard is the backstop)."""
+    if depth > _MAX_DEPTH:
+        return UNKNOWN, "", 0
+    if isinstance(expr, ast.Constant):
+        v = expr.value
+        if v is None:
+            return BUCKETED, "", 0       # no-floor sentinel
+        if isinstance(v, bool) or not isinstance(v, int):
+            return UNKNOWN, "", 0
+        if v == 0 or _is_pow2(v):
+            return BUCKETED, "", 0       # 0 = no-floor sentinel
+        return RAW, f"literal {v} is not a power of two", expr.lineno
+    if isinstance(expr, ast.Call):
+        name = _Graph._callee(expr)
+        if name in SANCTIONERS:
+            return BUCKETED, "", 0
+        if name == "len":
+            return RAW, "a raw len(...) reaches the jit boundary", \
+                expr.lineno
+        if name in ("min", "max"):
+            verdicts = [_classify(a, info, fn, graph, depth + 1,
+                                  visited) for a in expr.args]
+            if any(v[0] == RAW for v in verdicts):
+                return next(v for v in verdicts if v[0] == RAW)
+            if verdicts and all(v[0] == BUCKETED for v in verdicts):
+                return BUCKETED, "", 0
+        return UNKNOWN, "", 0
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in RAW_ATTRS:
+            return RAW, f"raw memo count .{expr.attr} reaches the " \
+                "jit boundary (one program per distinct history " \
+                "shape)", expr.lineno
+        if expr.attr in BUCKETED_ATTRS:
+            return BUCKETED, "", 0
+        return UNKNOWN, "", 0
+    if isinstance(expr, ast.Subscript):
+        base = expr.value
+        if isinstance(base, ast.Attribute) and base.attr == "shape":
+            return RAW, "raw .shape[...] reaches the jit boundary " \
+                "unbucketed", expr.lineno
+        return UNKNOWN, "", 0
+    if isinstance(expr, (ast.BoolOp, ast.IfExp)):
+        parts = (expr.values if isinstance(expr, ast.BoolOp)
+                 else [expr.body, expr.orelse])
+        verdicts = [_classify(p, info, fn, graph, depth + 1, visited)
+                    for p in parts]
+        for v in verdicts:
+            if v[0] == RAW:
+                return v
+        if verdicts and all(v[0] == BUCKETED for v in verdicts):
+            return BUCKETED, "", 0
+        return UNKNOWN, "", 0
+    if isinstance(expr, ast.BinOp):
+        for side in (expr.left, expr.right):
+            v = _classify(side, info, fn, graph, depth + 1, visited)
+            if v[0] == RAW:
+                return v
+        return UNKNOWN, "", 0
+    if isinstance(expr, ast.Name):
+        return _classify_name(expr.id, getattr(expr, "lineno", 0),
+                              info, fn, graph, depth, visited)
+    return UNKNOWN, "", 0
+
+
+def _classify_name(name: str, use_line: int, info: _FileInfo,
+                   fn: Optional[ast.AST], graph: _Graph, depth: int,
+                   visited: set):
+    # the LAST local assignment dominating the use site wins — the
+    # first-match rule both flagged `n = len(xs); n = next_pow2(n)`
+    # and waved through the reversed order
+    if fn is not None:
+        best = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name \
+                    and node.lineno < use_line \
+                    and (best is None or node.lineno > best.lineno):
+                best = node
+        if best is not None:
+            return _classify(best.value, info, fn, graph,
+                             depth + 1, visited)
+        # a parameter: chase every call site of the enclosing function
+        args = getattr(fn, "args", None)
+        if args is not None:
+            names = [a.arg for a in args.args]
+            if name in names:
+                return _chase_param(fn, name, names.index(name),
+                                    graph, depth, visited)
+    if name in info.consts:
+        v = info.consts[name]
+        if v == 0 or _is_pow2(v):
+            return BUCKETED, "", 0
+        return RAW, f"module constant {name}={v} is not a power of " \
+            "two", 0
+    return UNKNOWN, "", 0
+
+
+def _chase_param(fn: ast.AST, param: str, pos: int, graph: _Graph,
+                 depth: int, visited: set):
+    """Interprocedural step: classify the argument bound to ``param``
+    at every call site of ``fn``. A single provably-raw call site
+    makes the parameter RAW (anchored at that call site)."""
+    key = (id(fn), param)
+    if key in visited or depth > _MAX_DEPTH:
+        return UNKNOWN, "", 0
+    visited = visited | {key}
+    sites = graph.calls.get(fn.name, [])
+    if not sites:
+        return UNKNOWN, "", 0
+    defaults = getattr(fn, "args", None)
+    n_pos = len(defaults.args) if defaults is not None else 0
+    is_method = (defaults is not None and defaults.args
+                 and defaults.args[0].arg in ("self", "cls"))
+    any_unknown = not sites
+    all_bucketed = bool(sites)
+    for cinfo, call, cfn in sites:
+        arg_expr = None
+        for kw in call.keywords:
+            if kw.arg == param:
+                arg_expr = kw.value
+        # positional mapping: methods called through an attribute drop
+        # the self slot; other method call forms make no claim
+        cpos = pos
+        if is_method:
+            if not isinstance(call.func, ast.Attribute):
+                cpos = -1
+            else:
+                cpos = pos - 1
+        if arg_expr is None and 0 <= cpos < len(call.args) \
+                and not any(isinstance(a, ast.Starred)
+                            for a in call.args[:cpos + 1]) \
+                and pos < n_pos:
+            arg_expr = call.args[cpos]
+        if arg_expr is None:
+            any_unknown = True       # default value / splat: no claim
+            all_bucketed = False
+            continue
+        v, detail, anchor = _classify(arg_expr, cinfo, cfn, graph,
+                                      depth + 1, visited)
+        if v == RAW:
+            return RAW, f"{detail} (via {fn.name}({param}=...) at " \
+                f"{os.path.basename(cinfo.path)}:" \
+                f"{anchor or call.lineno})", anchor or call.lineno
+        if v != BUCKETED:
+            any_unknown = True
+            all_bucketed = False
+    if all_bucketed and not any_unknown:
+        return BUCKETED, "", 0
+    return UNKNOWN, "", 0
+
+
+def scan_files(paths: Sequence[str], *,
+               apply_suppressions: bool = True) -> List[Finding]:
+    """The ``unbucketed-dispatch-site`` rule over a file set (the
+    call graph is built over exactly these files)."""
+    infos: List[_FileInfo] = []
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=p)
+        except (OSError, SyntaxError):
+            continue                 # lint owns syntax errors
+        infos.append(_FileInfo(path=p, tree=tree,
+                               lines=src.splitlines()))
+    graph = _Graph(infos)
+    out: List[Finding] = []
+    for info in infos:
+        for name, spec in SHAPE_SINKS.items():
+            for cinfo, call, cfn in graph.calls.get(name, []):
+                if cinfo is not info:
+                    continue
+                exprs: List[Tuple[str, ast.AST]] = []
+                for kw in call.keywords:
+                    if kw.arg in spec.get("kwargs", ()):
+                        exprs.append((kw.arg, kw.value))
+                for pos in spec.get("pos", ()):
+                    if pos < len(call.args) and not any(
+                            isinstance(a, ast.Starred)
+                            for a in call.args[:pos + 1]):
+                        exprs.append((f"arg{pos}", call.args[pos]))
+                for argname, expr in exprs:
+                    v, detail, anchor = _classify(
+                        expr, info, cfn, graph, 0, set())
+                    if v != RAW:
+                        continue
+                    line = call.lineno
+                    out.append(Finding(
+                        "unbucketed-dispatch-site", info.path, line,
+                        f"{name}({argname}=...): {detail} — every "
+                        "jit-visible shape must come from a declared "
+                        "ladder (next_pow2 / service bucket / kernel "
+                        "spec); an unbucketed shape compiles one "
+                        "program per seed and can OOM LLVM"))
+    if not apply_suppressions:
+        return out
+    # suppressions apply at the sink line
+    by_path = {info.path: info.lines for info in infos}
+    return [f for f in out
+            if not suppressed(by_path.get(f.path, ()), f.line,
+                              f.rule)]
+
+
+__all__ = ["Axis", "Inventory", "Site", "SHAPE_SINKS",
+           "static_inventory", "render_programs", "scan_files",
+           "trace_witnesses", "witness_table"]

@@ -64,8 +64,8 @@ ENTRY_CALL_NAMES = {"check_device_seg", "check_device_seg2",
                     "check_device_pallas_stream", "pad_succ"}
 
 
-def scan_file(path: str,
-              source: Optional[str] = None) -> List[Finding]:
+def scan_file(path: str, source: Optional[str] = None, *,
+              apply_suppressions: bool = True) -> List[Finding]:
     if source is None:
         with open(path, encoding="utf-8") as fh:
             source = fh.read()
@@ -117,6 +117,8 @@ def scan_file(path: str,
                             f"{kw.arg}={v} at a jit boundary is not "
                             "a pow2 bucket — shape buckets must be "
                             "closed"))
+    if not apply_suppressions:
+        return raw
     return [f for f in raw if not suppressed(lines, f.line, f.rule)]
 
 

@@ -336,8 +336,11 @@ def _dup_cond_findings(info: _ModuleInfo, path: str,
     return out
 
 
-def lint_file(path: str, source: Optional[str] = None) -> List[Finding]:
-    """All lint findings for one file (suppressions applied)."""
+def lint_file(path: str, source: Optional[str] = None, *,
+              apply_suppressions: bool = True) -> List[Finding]:
+    """All lint findings for one file (suppressions applied unless
+    ``apply_suppressions=False`` — the stale-suppression audit needs
+    the raw findings to decide which markers still earn their keep)."""
     if source is None:
         with open(path, encoding="utf-8") as fh:
             source = fh.read()
@@ -430,6 +433,8 @@ def lint_file(path: str, source: Optional[str] = None) -> List[Finding]:
                 "stay :info (PassThrough client) or history.complete "
                 "rejects the history"))
 
+    if not apply_suppressions:
+        return raw
     return [f for f in raw if not suppressed(lines, f.line, f.rule)]
 
 

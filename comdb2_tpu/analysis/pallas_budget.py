@@ -261,8 +261,8 @@ def _call_name(node: ast.Call) -> str:
     return ""
 
 
-def scan_file(path: str,
-              source: Optional[str] = None) -> List[Finding]:
+def scan_file(path: str, source: Optional[str] = None, *,
+              apply_suppressions: bool = True) -> List[Finding]:
     """AST budget scan of one file."""
     if source is None:
         with open(path, encoding="utf-8") as fh:
@@ -340,6 +340,8 @@ def scan_file(path: str,
             if k is not None:
                 raw += check_config(K=k, where="spec_for", path=path,
                                     line=node.lineno)
+    if not apply_suppressions:
+        return raw
     return [f for f in raw if not suppressed(lines, f.line, f.rule)]
 
 

@@ -715,10 +715,18 @@ def _build_kernel(spec: SegKernelSpec):
     return kernel
 
 
+#: fused-kernel programs built this process — one Mosaic compile per
+#: distinct (spec, b_pad); the compile-surface guard diffs it the way
+#: bench_txn diffs closure_jax.DISPATCHES (utils/compile_guard.py)
+MOSAIC_BUILDS = 0
+
+
 @functools.lru_cache(maxsize=32)
 def _chunk_call(spec: SegKernelSpec, b_pad: int = 8):
     """b_pad: rows of the per-history results buffer (multi-history
     streams); single-history runs pass a dummy 8-row buffer."""
+    global MOSAIC_BUILDS
+    MOSAIC_BUILDS += 1
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl

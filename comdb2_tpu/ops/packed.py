@@ -94,7 +94,7 @@ def _materialize_ops(p: PackedHistory) -> List[Op]:
     t = p.time.tolist()
     fl = p.fails.tolist()
     # the API edge: reporting needs real Op objects back
-    for i, (pc, tc, fc, vc) in enumerate(zip(  # analysis: ignore[per-op-host-loop]
+    for i, (pc, tc, fc, vc) in enumerate(zip(
             p.process.tolist(), p.type.tolist(), p.f.tolist(),
             p.value.tolist())):
         out.append(Op(

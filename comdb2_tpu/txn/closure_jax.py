@@ -19,12 +19,14 @@ the batch axis is pow2 too (service convention).
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 #: dispatch counter — bench_txn asserts the single-dispatch rule on it
 DISPATCHES = 0
+
+#: closure programs built this process (one per N bucket) — the
+#: compile-surface guard diffs it (utils/compile_guard.py)
+COMPILES = 0
 
 
 def _jnp():
@@ -84,13 +86,21 @@ _JITTED = {}
 
 def _jitted(n: int):
     """One jit wrapper per N bucket (jax.jit itself specializes per
-    input shape, so the single and batched entries share it)."""
+    input shape, so the single and batched entries share it). Named
+    wrapper, not ``partial``: the compile log (and so the compile-
+    surface guard) keys programs by the jit name, and a partial
+    lowers as ``<unnamed wrapped function>``."""
+    global COMPILES
     import jax
 
     fn = _JITTED.get(n)
     if fn is None:
-        fn = jax.jit(partial(_diag_kernel, n=n))
+        def closure_diag_kernel(planes):
+            return _diag_kernel(planes, n=n)
+
+        fn = jax.jit(closure_diag_kernel)
         _JITTED[n] = fn
+        COMPILES += 1
     return fn
 
 
@@ -137,5 +147,5 @@ def cyclic_layers_device(adj: np.ndarray,
     return closure_diag(padded)[:, :n]
 
 
-__all__ = ["DISPATCHES", "closure_diag", "closure_diag_batch",
-           "cyclic_layers_device"]
+__all__ = ["COMPILES", "DISPATCHES", "closure_diag",
+           "closure_diag_batch", "cyclic_layers_device"]

@@ -286,11 +286,28 @@ def main() -> int:
         "latency_ms": lat,
         "overload_replies": overloads,
         "survived_disconnect": survived,
+        "programs_after_warm": st0["programs"],
+        "programs_after_timed": st2["programs"],
     }
     line = json.dumps(out)
     print(line)
     with open(args.out, "w") as fh:
         fh.write(line + "\n")
+    # compile-surface closure, via the daemon's own program-key
+    # metrics (the daemon is a subprocess, so the in-process compile
+    # guard can't see it): after the warm phase every program class
+    # this traffic can need exists, so the TIMED phases must compile
+    # nothing new — program-set growth in steady state is exactly the
+    # recompile storm the bucketed admission exists to prevent.
+    # Asserted AFTER the artifact write so a failing run still leaves
+    # the diagnostic JSON behind (same order as bench_txn/bench_shrink)
+    from comdb2_tpu.utils import compile_guard
+    if compile_guard.enabled() and st2["programs"] != st0["programs"]:
+        print(f"FAIL: daemon compiled "
+              f"{st2['programs'] - st0['programs']} new program(s) "
+              "during the timed phases — the bucket ladder is not "
+              "closed over this traffic", file=sys.stderr)
+        return 1
     if args.min_speedup and speedup < args.min_speedup:
         print(f"FAIL: speedup {speedup:.2f} < {args.min_speedup}",
               file=sys.stderr)

@@ -205,6 +205,15 @@ def main() -> None:
                        "the declared injected latency; on the real "
                        "tunnel each dispatch pays ~100 ms for free")
 
+    from comdb2_tpu.analysis.compile_surface import static_inventory
+    from comdb2_tpu.utils import compile_guard
+
+    inv = static_inventory()
+    # try/finally, not a bare start(): the guard must detach (and
+    # jax_log_compiles restore) even when a shape assertion below
+    # fails mid-run
+    g = compile_guard.CompileGuard().start()
+
     shapes = [
         ("register-2k-stale-read", "linear",
          lambda: register_seed(2000, "stale-read")),
@@ -217,65 +226,73 @@ def main() -> None:
         ("txn-T-write-skew", "txn", lambda: txn_seed("T")),
         ("txn-R-dirty-commit", "txn", lambda: txn_seed("R")),
     ]
-    for name, axis, make in shapes:
-        h, truth = make()
-        if axis == "linear":
-            mk_b = lambda: Shrinker(h, "cas-register",  # noqa: E731
-                                    F=args.frontier)
-            mk_s = lambda: serial_linear(h, args.frontier)  # noqa: E731
-        else:
-            mk_b = lambda: TxnShrinker(h)               # noqa: E731
-            mk_s = lambda: serial_txn(h)                # noqa: E731
-        rb, wall_b = time_path(mk_b, lat)
-        rs, wall_s = time_path(mk_s, lat)
-        assert rb.one_minimal and not rb.partial, name
-        assert rb.n_ops == rs.n_ops, \
-            f"{name}: batched/serial minima differ ({rb.n_ops} vs " \
-            f"{rs.n_ops}) — same rounds, same verdicts expected"
-        if truth is not None:
-            assert rb.n_ops == len(truth), \
-                f"{name}: missed the ground truth " \
-                f"({rb.n_ops} vs {len(truth)})"
-        db = rb.dispatches
-        ds = rs.dispatches
-        assert ds > db, f"{name}: serial used {ds} dispatches vs " \
-                        f"batched {db} — no amortization?"
-        assert wall_s > wall_b, \
-            f"{name}: batched did not win wall ({wall_b:.2f}s vs " \
-            f"{wall_s:.2f}s)"
-        entry = {
-            "axis": axis, "seed_ops": rb.seed_ops,
-            "minimal_ops": rb.n_ops, "rounds": rb.rounds,
-            "candidates": rb.candidates,
-            "dispatches_batched": db, "dispatches_serial": ds,
-            "candidates_per_dispatch": round(rb.candidates / db, 2),
-            "wall_batched_s": round(wall_b, 3),
-            "wall_serial_s": round(wall_s, 3),
-            "speedup": round(wall_s / wall_b, 3),
-            "one_minimal": rb.one_minimal,
-        }
-        if axis == "txn":
-            entry["anomaly_class"] = rb.extra.get("anomaly_class")
-            entry["minimal_txns"] = len(rb.extra.get("txns", ()))
-        if name == "register-10k-stale-read":
-            flagship_ops = rb.ops
-        out["shapes"][name] = entry
-        print(f"{name:26s} {rb.seed_ops:6d} -> {rb.n_ops:3d} ops  "
-              f"rounds {rb.rounds:3d}  disp {db:3d} vs {ds:3d}  "
-              f"wall {wall_b:7.2f}s vs {wall_s:7.2f}s  "
-              f"x{wall_s / wall_b:5.2f}", flush=True)
+    try:
+        for name, axis, make in shapes:
+            h, truth = make()
+            if axis == "linear":
+                mk_b = lambda: Shrinker(h, "cas-register",  # noqa: E731
+                                        F=args.frontier)
+                mk_s = lambda: serial_linear(h, args.frontier)  # noqa: E731
+            else:
+                mk_b = lambda: TxnShrinker(h)               # noqa: E731
+                mk_s = lambda: serial_txn(h)                # noqa: E731
+            rb, wall_b = time_path(mk_b, lat)
+            rs, wall_s = time_path(mk_s, lat)
+            assert rb.one_minimal and not rb.partial, name
+            assert rb.n_ops == rs.n_ops, \
+                f"{name}: batched/serial minima differ ({rb.n_ops} vs " \
+                f"{rs.n_ops}) — same rounds, same verdicts expected"
+            if truth is not None:
+                assert rb.n_ops == len(truth), \
+                    f"{name}: missed the ground truth " \
+                    f"({rb.n_ops} vs {len(truth)})"
+            db = rb.dispatches
+            ds = rs.dispatches
+            assert ds > db, f"{name}: serial used {ds} dispatches vs " \
+                            f"batched {db} — no amortization?"
+            assert wall_s > wall_b, \
+                f"{name}: batched did not win wall ({wall_b:.2f}s vs " \
+                f"{wall_s:.2f}s)"
+            entry = {
+                "axis": axis, "seed_ops": rb.seed_ops,
+                "minimal_ops": rb.n_ops, "rounds": rb.rounds,
+                "candidates": rb.candidates,
+                "dispatches_batched": db, "dispatches_serial": ds,
+                "candidates_per_dispatch": round(rb.candidates / db, 2),
+                "wall_batched_s": round(wall_b, 3),
+                "wall_serial_s": round(wall_s, 3),
+                "speedup": round(wall_s / wall_b, 3),
+                "one_minimal": rb.one_minimal,
+            }
+            if axis == "txn":
+                entry["anomaly_class"] = rb.extra.get("anomaly_class")
+                entry["minimal_txns"] = len(rb.extra.get("txns", ()))
+            if name == "register-10k-stale-read":
+                flagship_ops = rb.ops
+            out["shapes"][name] = entry
+            print(f"{name:26s} {rb.seed_ops:6d} -> {rb.n_ops:3d} ops  "
+                  f"rounds {rb.rounds:3d}  disp {db:3d} vs {ds:3d}  "
+                  f"wall {wall_b:7.2f}s vs {wall_s:7.2f}s  "
+                  f"x{wall_s / wall_b:5.2f}", flush=True)
 
-    # the acceptance flagship: a 10k-event seeded failure minimizes to
-    # <= 20 ops and the certificate survives the host oracle
-    flag = out["shapes"]["register-10k-stale-read"]
-    assert flag["minimal_ops"] <= 20, flag
-    assert oracle_one_minimal(flagship_ops), \
-        "host oracle refutes the 1-minimality certificate"
-    out["flagship_oracle_one_minimal"] = True
+        # the acceptance flagship: a 10k-event seeded failure minimizes to
+        # <= 20 ops and the certificate survives the host oracle
+        flag = out["shapes"]["register-10k-stale-read"]
+        assert flag["minimal_ops"] <= 20, flag
+        assert oracle_one_minimal(flagship_ops), \
+            "host oracle refutes the 1-minimality certificate"
+        out["flagship_oracle_one_minimal"] = True
+    finally:
+        g.stop()
 
+    # every shrink round's candidate batches must ride the closed
+    # pow2-bucketed program set — observed compiles ⊆ PROGRAMS.md
+    out["compile_guard"] = g.summary(inv)
     with open(args.json, "w") as fh:
         fh.write(json.dumps(out) + "\n")
     print(f"wrote {args.json}")
+    if compile_guard.enabled():
+        g.assert_closed(inv)
 
 
 if __name__ == "__main__":

@@ -100,26 +100,39 @@ def main() -> None:
                        "matmuls run on host vector units — crossover "
                        "numbers are only meaningful vs the tunnel+MXU "
                        "model (docs/serializability.md)")
-    for n in (int(s) for s in args.sizes.split(",")):
-        for dense in (False, True):
-            shape = f"{'dense' if dense else 'sparse'}-n{n}"
-            adj = make_graph(rng, n, dense)
-            host_s, dh = bench_host(adj, realtime=dense)
-            dev_s, dd = bench_device(adj, realtime=dense)
-            assert np.array_equal(dh, dd), f"engine mismatch at {shape}"
-            edges = int(adj[:3].sum() + (adj[3].sum() if dense else 0))
-            out["shapes"][shape] = {
-                "txns": n, "edges": edges,
-                "host_s": round(host_s, 5),
-                "device_s": round(dev_s, 5),
-                "speedup": round(host_s / dev_s, 3) if dev_s else None,
-            }
-            print(f"{shape:16s} E={edges:9d}  host {host_s:8.4f}s  "
-                  f"device {dev_s:8.4f}s  x{host_s / dev_s:7.2f}",
-                  flush=True)
+    from comdb2_tpu.analysis.compile_surface import static_inventory
+    from comdb2_tpu.utils import compile_guard
+
+    inv = static_inventory()
+    with compile_guard.guard() as g:
+        for n in (int(s) for s in args.sizes.split(",")):
+            for dense in (False, True):
+                shape = f"{'dense' if dense else 'sparse'}-n{n}"
+                adj = make_graph(rng, n, dense)
+                host_s, dh = bench_host(adj, realtime=dense)
+                dev_s, dd = bench_device(adj, realtime=dense)
+                assert np.array_equal(dh, dd), \
+                    f"engine mismatch at {shape}"
+                edges = int(adj[:3].sum()
+                            + (adj[3].sum() if dense else 0))
+                out["shapes"][shape] = {
+                    "txns": n, "edges": edges,
+                    "host_s": round(host_s, 5),
+                    "device_s": round(dev_s, 5),
+                    "speedup": round(host_s / dev_s, 3)
+                    if dev_s else None,
+                }
+                print(f"{shape:16s} E={edges:9d}  host {host_s:8.4f}s"
+                      f"  device {dev_s:8.4f}s  x{host_s / dev_s:7.2f}",
+                      flush=True)
+    # observed closure programs must stay inside the static inventory
+    # (one per pow2 N bucket) — a recompile storm fails the bench
+    out["compile_guard"] = g.summary(inv)
     with open(args.json, "w") as fh:
         fh.write(json.dumps(out) + "\n")
     print(f"wrote {args.json}")
+    if compile_guard.enabled():
+        g.assert_closed(inv)
 
 
 if __name__ == "__main__":

@@ -1,43 +1,139 @@
 #!/usr/bin/env bash
-# Repo smoke check: the static invariant checker plus a sanitizer-wired
-# native configure/build and a ct_pmux start/exit run under ASan
+# Repo smoke check: the static invariant checker, a sanitizer-wired
+# native configure/build, native static analysis (clang-tidy or GCC
+# -fanalyzer), and the ct_pmux/txn/shrink/service smokes
 # (docs/static_analysis.md). Exits non-zero on any violation.
+#
+# --json: one machine-readable line per stage on stdout
+# ({"stage": ..., "ok": true|false, "secs": N}) so automation can gate
+# per stage; human banners are suppressed.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
+JSON_MODE=0
+if [ "${1:-}" = "--json" ]; then
+    JSON_MODE=1
+    shift
+fi
+
 # APPEND to PYTHONPATH — overriding it drops the axon plugin (CLAUDE.md)
 export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
 
-echo "== static invariant checker =="
-python -m comdb2_tpu.analysis
+CURRENT_STAGE=""
+STAGE_START=$SECONDS
+CLEANUP_PIDS=""
 
-echo "== pack parity smoke (legacy vs columnar ingest) =="
+json_line() {
+    printf '{"stage": "%s", "ok": %s, "secs": %s}\n' "$1" "$2" "$3"
+}
+
+stage_end_ok() {
+    if [ -n "$CURRENT_STAGE" ] && [ "$JSON_MODE" = 1 ]; then
+        json_line "$CURRENT_STAGE" true $((SECONDS - STAGE_START))
+    fi
+    CURRENT_STAGE=""
+}
+
+stage() {            # stage <id> <human banner...>
+    stage_end_ok
+    CURRENT_STAGE="$1"
+    STAGE_START=$SECONDS
+    shift
+    if [ "$JSON_MODE" = 0 ]; then
+        echo "== $* =="
+    fi
+}
+
+on_exit() {
+    rc=$?
+    for pid in $CLEANUP_PIDS; do
+        kill "$pid" 2>/dev/null || true
+    done
+    if [ "$rc" -ne 0 ] && [ -n "$CURRENT_STAGE" ] \
+            && [ "$JSON_MODE" = 1 ]; then
+        json_line "$CURRENT_STAGE" false $((SECONDS - STAGE_START))
+    fi
+}
+trap on_exit EXIT
+
+# In JSON mode stage output moves to stderr (the JSON lines ARE the
+# stdout contract) — findings and diagnostics stay visible either way.
+run() {
+    if [ "$JSON_MODE" = 1 ]; then
+        "$@" 1>&2
+    else
+        "$@"
+    fi
+}
+
+stage analysis "static invariant checker"
+run python -m comdb2_tpu.analysis
+
+stage pack-parity "pack parity smoke (legacy vs columnar ingest)"
 # one fixture per corpus family; any segment-stream diff fails CI
 # before the slow tier ever runs
-JAX_PLATFORMS=cpu python scripts/pack_parity_smoke.py
+run env JAX_PLATFORMS=cpu python scripts/pack_parity_smoke.py
 
-echo "== native configure/build with ASan =="
+stage asan-build "native configure/build with ASan"
 if command -v cmake >/dev/null; then
     cmake -DCT_SANITIZE=address -S native -B native/build-asan \
         >/dev/null
     cmake --build native/build-asan -j"$(nproc)" >/dev/null
 else
     # containers without cmake: same flags CT_SANITIZE=address wires
-    echo "cmake not found — direct g++ ASan build of ct_pmux"
+    [ "$JSON_MODE" = 1 ] || \
+        echo "cmake not found — direct g++ ASan build of ct_pmux"
     mkdir -p native/build-asan
     g++ -fsanitize=address -fno-omit-frame-pointer -g -Wall -Wextra \
         -Inative/include native/src/pmux_main.cpp \
         -o native/build-asan/ct_pmux -lpthread
 fi
 
-echo "== ct_pmux start/exit under ASan =="
+stage native-static-analysis \
+    "native static analysis (clang-tidy or GCC -fanalyzer)"
+# clang-tidy findings fail the build itself (warnings-as-errors);
+# -fanalyzer emits warnings, so the build log is grepped — any
+# -Wanalyzer finding in ct_pmux/sut_node/client sources fails here
+TIDY_LOG=$(mktemp)
+if command -v cmake >/dev/null; then
+    cmake -DCT_STATIC_ANALYZER=ON -S native -B native/build-tidy \
+        >/dev/null
+    if ! cmake --build native/build-tidy -j"$(nproc)" \
+            >"$TIDY_LOG" 2>&1; then
+        tail -40 "$TIDY_LOG" >&2
+        echo "native static analysis build failed" >&2
+        rm -f "$TIDY_LOG"
+        exit 1
+    fi
+else
+    : >"$TIDY_LOG"
+    for src in native/src/*.cpp; do
+        if ! g++ -fanalyzer -Wall -Wextra -Inative/include -c "$src" \
+                -o /tmp/ct_analyze.o >>"$TIDY_LOG" 2>&1; then
+            tail -40 "$TIDY_LOG" >&2
+            echo "native static analysis: $src failed to compile" >&2
+            rm -f "$TIDY_LOG" /tmp/ct_analyze.o
+            exit 1
+        fi
+    done
+    rm -f /tmp/ct_analyze.o
+fi
+if grep -E '\-Wanalyzer|warning:.*\[(bugprone|clang-analyzer|performance)' \
+        "$TIDY_LOG" >&2; then
+    echo "native static analysis found issues (log above)" >&2
+    rm -f "$TIDY_LOG"
+    exit 1
+fi
+rm -f "$TIDY_LOG"
+
+stage pmux-smoke "ct_pmux start/exit under ASan"
 PMUX=native/build-asan/ct_pmux
 PORT=${CT_CHECK_PMUX_PORT:-15105}
 # halt_on_error so a shutdown race fails the script, not just logs
 ASAN_OPTIONS=halt_on_error=1 "$PMUX" -p "$PORT" &
 PMUX_PID=$!
-trap 'kill "$PMUX_PID" 2>/dev/null || true' EXIT
+CLEANUP_PIDS="$PMUX_PID"
 for _ in $(seq 50); do
     if bash -c "true >/dev/tcp/127.0.0.1/$PORT" 2>/dev/null; then
         break
@@ -49,9 +145,9 @@ printf 'hello\nexit\n' >&3
 cat <&3 >/dev/null || true
 exec 3<&- 3>&-
 wait "$PMUX_PID"   # non-zero (ASan abort) fails the check
-trap - EXIT
+CLEANUP_PIDS=""
 
-echo "== txn serializability checker smoke (host engine) =="
+stage txn-smoke "txn serializability checker smoke (host engine)"
 # the seeded G2 write-skew fixture MUST be caught (exit 1 = invalid);
 # a miss (exit 0) or a give-up (exit 2) fails the repo check — and
 # the clean twin must pass, so the detector can't cheat by flagging
@@ -65,15 +161,15 @@ JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --txn --backend host \
 RC_CLEAN=$?
 set -e
 if [ "$RC_BAD" -ne 1 ]; then
-    echo "txn checker MISSED the seeded G2-item cycle (rc=$RC_BAD)"
+    echo "txn checker MISSED the seeded G2-item cycle (rc=$RC_BAD)" >&2
     exit 1
 fi
 if [ "$RC_CLEAN" -ne 0 ]; then
-    echo "txn checker flagged the clean fixture (rc=$RC_CLEAN)"
+    echo "txn checker flagged the clean fixture (rc=$RC_CLEAN)" >&2
     exit 1
 fi
 
-echo "== shrink smoke (seeded stale-read fixture) =="
+stage shrink-smoke "shrink smoke (seeded stale-read fixture)"
 # the fixture plants a single stale read into a write-only history
 # (known minimum: ONE read pair); the minimizer must reach it and the
 # minimal history must still be INVALID on offline re-check
@@ -85,15 +181,18 @@ JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --shrink \
 RC_SHRINK=$?
 set -e
 if [ "$RC_SHRINK" -ne 1 ]; then
-    echo "shrink seed fixture not INVALID (rc=$RC_SHRINK)"; exit 1
+    echo "shrink seed fixture not INVALID (rc=$RC_SHRINK)" >&2
+    exit 1
 fi
 MINIMAL=$(ls "$SHRINK_STORE"/shrink/*/minimal.edn 2>/dev/null | head -1)
 if [ -z "$MINIMAL" ]; then
-    echo "shrink wrote no minimal.edn"; exit 1
+    echo "shrink wrote no minimal.edn" >&2
+    exit 1
 fi
 OPS=$(grep -c ':process' "$MINIMAL")
 if [ "$OPS" -gt 2 ]; then
-    echo "shrink left $OPS ops (known minimum is 2)"; exit 1
+    echo "shrink left $OPS ops (known minimum is 2)" >&2
+    exit 1
 fi
 set +e
 JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --backend host \
@@ -101,12 +200,12 @@ JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --backend host \
 RC_MIN=$?
 set -e
 if [ "$RC_MIN" -ne 1 ]; then
-    echo "minimal.edn re-check rc=$RC_MIN (must still be INVALID)"
+    echo "minimal.edn re-check rc=$RC_MIN (must still be INVALID)" >&2
     exit 1
 fi
 rm -rf "$SHRINK_STORE"
 
-echo "== verifier service smoke (CPU backend) =="
+stage service-smoke "verifier service smoke (CPU backend)"
 # zombie baseline BEFORE the daemon runs: the post-shutdown check
 # below must catch NEW zombies (a reaped child can't show Z, so the
 # meaningful assertion is "no more Z states than before, and no
@@ -116,13 +215,13 @@ SVC_LOG=$(mktemp)
 JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
     --backend cpu --no-prime --frontier 64 >"$SVC_LOG" 2>&1 &
 SVC_PID=$!
-trap 'kill "$SVC_PID" 2>/dev/null || true' EXIT
+CLEANUP_PIDS="$SVC_PID"
 for _ in $(seq 200); do     # the ready line carries the chosen port
     grep -q '"ready"' "$SVC_LOG" 2>/dev/null && break
     sleep 0.1
 done
-grep -q '"ready"' "$SVC_LOG" || { echo "daemon never became ready"; \
-    cat "$SVC_LOG"; exit 1; }
+grep -q '"ready"' "$SVC_LOG" || { echo "daemon never became ready" >&2; \
+    cat "$SVC_LOG" >&2; exit 1; }
 SVC_LOG="$SVC_LOG" python - <<'EOF'
 import json, os
 from comdb2_tpu.ops import op as O
@@ -148,19 +247,25 @@ assert st["completed"] >= 1 and st["dispatches"] >= 1, st
 assert c.shutdown()
 EOF
 wait "$SVC_PID"            # clean exit 0, and the wait reaps it
-trap - EXIT
+CLEANUP_PIDS=""
 # the daemon itself is reaped by the wait above — what must NOT
 # remain is any surviving service process or a NEW zombie it left
 # behind (ps -o stat= per CLAUDE.md: pkill'd daemons linger as Z)
 if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
-    echo "verifier daemon left a process behind"; exit 1
+    echo "verifier daemon left a process behind" >&2
+    exit 1
 fi
 ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
 if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
     echo "verifier daemon left a zombie" \
-         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)"; exit 1
+         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
+    exit 1
 fi
 
-echo "OK: checker clean, ASan build clean, ct_pmux shutdown clean," \
-     "txn smoke caught the seeded cycle, shrink smoke reached the" \
-     "known minimum, verifier service shutdown clean"
+stage_end_ok
+if [ "$JSON_MODE" = 0 ]; then
+    echo "OK: checker clean, ASan build clean, native static" \
+         "analysis clean, ct_pmux shutdown clean, txn smoke caught" \
+         "the seeded cycle, shrink smoke reached the known minimum," \
+         "verifier service shutdown clean"
+fi

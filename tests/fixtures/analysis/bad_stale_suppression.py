@@ -1,0 +1,12 @@
+"""Seeded violation: an ``analysis: ignore`` marker whose rule no
+longer trips on its line. The suppression audit must flag it —
+otherwise dead markers accumulate and silently swallow the NEXT real
+finding on their line."""
+
+import numpy as np
+
+
+def tidy(rows):
+    # this line trips nothing: the marker below is pure rot
+    out = np.sort(rows)  # analysis: ignore[hash-dedup]
+    return out

@@ -1,0 +1,33 @@
+"""Seeded violation: raw (unbucketed) shapes reach a batch jit
+boundary — laundered through a helper function, so only the
+INTERPROCEDURAL chase of the ``unbucketed-dispatch-site`` rule can
+tie the raw ``memo.n_states`` at the call site to the engine entry's
+shape argument. One compiled program per distinct history shape;
+recompiles can OOM LLVM."""
+
+from comdb2_tpu.checker import linear_jax as LJ
+from comdb2_tpu.checker.batch import check_batch
+
+
+def _dispatch(succ, sb, n_states, n_transitions):
+    # the sink: a batched engine entry whose static shape args come
+    # from the caller's parameters
+    return LJ.check_device_seg_batch(
+        succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+        F=128, P=4, n_states=n_states, n_transitions=n_transitions)
+
+
+def check_all(batches):
+    out = []
+    for memo, sb in batches:
+        # BUG: raw memo counts, no next_pow2 — every distinct history
+        # shape compiles a fresh program
+        out.append(_dispatch(memo.succ, sb, memo.n_states,
+                             memo.n_transitions))
+    return out
+
+
+def check_one(batch, items):
+    # BUG: a raw item count as the segment floor — same hazard,
+    # provable without the call-graph chase
+    return check_batch(batch, s_pad=len(items))

@@ -41,6 +41,8 @@ FIXTURE_RULES = {
     "bad_pallas_block.py": "pallas-block-shape",
     "bad_pallas_k9.py": "pallas-k-cap",
     "bad_unbucketed_shape.py": "jaxpr-unbucketed-shape",
+    "bad_unbucketed_dispatch.py": "unbucketed-dispatch-site",
+    "bad_stale_suppression.py": "stale-suppression",
 }
 
 
@@ -68,8 +70,8 @@ def test_fixture_inventory_matches_readme():
     on_disk = {f for f in os.listdir(FIXTURES) if f.endswith(".py")}
     assert on_disk == set(FIXTURE_RULES), \
         "fixtures/analysis/ and FIXTURE_RULES drifted apart"
-    # the acceptance floor: >= 8 fixtures across all three families
-    assert len(FIXTURE_RULES) >= 8
+    # the acceptance floor: >= 16 fixtures across the pass families
+    assert len(FIXTURE_RULES) >= 16
 
 
 @pytest.mark.parametrize("fixture,rule", sorted(FIXTURE_RULES.items()))
@@ -220,3 +222,39 @@ def test_cli_json_artifact(tmp_path):
     data = json.loads(out.read_text())
     assert data and data[0]["rule"] == "pallas-k-cap"
     assert table.read_text().startswith("# Pallas budget table")
+
+
+def test_cli_json_exit_code_regression(tmp_path):
+    """``--json`` must not absorb the failure: findings still exit
+    non-zero with the artifact written, and a clean file still exits
+    zero (with an empty artifact)."""
+    import json
+
+    out = tmp_path / "findings.json"
+    r = _run_cli("--json", str(out),
+                 os.path.join(FIXTURES, "bad_multiprocessing.py"))
+    assert r.returncode != 0
+    assert json.loads(out.read_text())
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    out2 = tmp_path / "clean.json"
+    r = _run_cli("--json", str(out2), str(clean))
+    assert r.returncode == 0
+    assert json.loads(out2.read_text()) == []
+
+
+def test_cli_reports_per_pass_timing():
+    """Slow passes must be visible: one timed line per pass on
+    stderr."""
+    r = _run_cli(os.path.join(FIXTURES, "bad_multiprocessing.py"))
+    for name in ("lint", "pallas-budget", "jaxpr-audit",
+                 "compile-surface", "suppression-audit"):
+        assert f"pass {name}:" in r.stderr, r.stderr
+
+
+def test_cli_programs_artifact(tmp_path):
+    progs = tmp_path / "PROGRAMS.md"
+    r = _run_cli("--programs", str(progs),
+                 os.path.join(FIXTURES, "bad_multiprocessing.py"))
+    assert r.returncode == 1            # the fixture still fails
+    assert progs.read_text().startswith("# Compile-surface inventory")
