@@ -191,6 +191,7 @@ def _ladders() -> dict:
     never from observed traffic (the whole point is that traffic can't
     widen the set)."""
     from ..service.bucketing import ServiceLimits
+    from ..service.sharding import MAX_SHARDS
     from ..shrink.verdicts import MAX_BATCH, MIN_BUCKET
     from ..txn.edges import TXN_N_FLOOR
     from ..utils import next_pow2
@@ -203,6 +204,7 @@ def _ladders() -> dict:
         "limits": lim,
         "fuzz_buckets": tuple(PRODUCTION_BUCKETS),
         "specs": specs,
+        "mesh_D": (1, MAX_SHARDS),
         "kernel_chunks": tuple(sorted({s.chunk for s in specs})),
         "kernel_widths": tuple(sorted({2 + 2 * s.K for s in specs})),
         "kernel_rows": tuple(sorted({s.rows for s in specs})),
@@ -232,6 +234,11 @@ INFRA_NAMES = frozenset({
     "convert_element_type", "_threefry_seed", "_uint32",
     "iota", "arange", "broadcast_in_dim", "reshape", "concatenate",
     "_power", "true_divide", "floor_divide", "remainder",
+    # sharded-array readback glue: jax fetches a mesh-sharded output
+    # through one _multi_slice program per (shape, sharding) — pure
+    # host-transfer plumbing, shapes follow the (already constrained)
+    # engine outputs
+    "_multi_slice",
 })
 
 
@@ -261,12 +268,23 @@ def static_inventory() -> Inventory:
     table_rows = Axis("table_rows", "enum",
                       values=L["kernel_table_rows"])
     b_pad = Axis("b_pad", "pow2", 8, 2048)
+    mesh_D = Axis("D", "pow2", *L["mesh_D"])
     run_templates = []
+    run_sharded_templates = []
     for W in L["kernel_words"]:
         run_templates.append(
             ((n_chunks, chunk, width),)
             + ((rows, lane),) * W
             + ((one, lane), (b_pad, lane), (table_rows, lane), ()))
+        # the shard_map form: every per-shard tensor gains the leading
+        # mesh axis; per-shard shapes are the bucketed shapes divided
+        # by D (global / D — both pow2, so the division stays on the
+        # ladder). Table + stride stay replicated.
+        run_sharded_templates.append(
+            ((mesh_D, n_chunks, chunk, width),)
+            + ((mesh_D, rows, lane),) * W
+            + ((mesh_D, one, lane), (mesh_D, b_pad, lane),
+               (table_rows, lane), ()))
 
     N = Axis("N", "pow2", *L["txn_N"])
     N8 = Axis("N/8", "pow2", L["txn_N"][0] // 8, L["txn_N"][1] // 8)
@@ -275,26 +293,36 @@ def static_inventory() -> Inventory:
     sites = (
         Site(
             key="pallas-stream-scan",
-            jit_names=("run",),
+            jit_names=("run", "run_sharded"),
             note="fused-kernel chunk scan (checker/pallas_seg._scan_fn)"
                  ": one Mosaic program per (SegKernelSpec, b_pad, "
                  "stream); specs are drawn from the production tier "
                  "table (pallas_budget.production_tiers), b_pad is the "
                  "pow2 results-buffer bucket, chunk count is the "
-                 "chunked-engine scan length (linear by design)",
-            templates=tuple(run_templates),
-            axes_doc=(chunk, width, rows, table_rows, b_pad,
+                 "chunked-engine scan length (linear by design). "
+                 "`run_sharded` (pallas_seg._sharded_scan_fn) is the "
+                 "shard_map form: the SAME per-shard kernel body with "
+                 "a leading mesh axis D on every per-shard tensor — "
+                 "per-shard shapes are the global shapes divided by D",
+            templates=tuple(run_templates)
+            + tuple(run_sharded_templates),
+            axes_doc=(chunk, width, rows, table_rows, b_pad, mesh_D,
                       Axis("n_words", "enum",
                            values=L["kernel_words"]), n_chunks),
         ),
         Site(
             key="xla-batch-engines",
             jit_names=("check_device_keys", "check_device_flat",
-                       "check_device_seg_batch"),
+                       "check_device_seg_batch",
+                       "check_device_keys_sharded"),
             note="batched XLA engines (checker/linear_jax): segment "
                  "tensors (S, B, K) with every axis pow2 "
                  "(segment_batch pads, service buckets floor), memo "
-                 "table dims pow2 (pad_succ)",
+                 "table dims pow2 (pad_succ). "
+                 "`check_device_keys_sharded` shard_maps the keys/flat "
+                 "body over the mesh batch axis: global shapes are "
+                 "identical (B pow2, padded to a multiple of D), each "
+                 "shard compiles B/D lanes",
             templates=(xla_batch_seg,),
             axes_doc=(memo, S, B, K),
         ),
@@ -324,13 +352,17 @@ def static_inventory() -> Inventory:
         ),
         Site(
             key="txn-closure",
-            jit_names=("closure_diag_kernel",),
+            jit_names=("closure_diag_kernel",
+                       "closure_diag_kernel_sharded"),
             note="txn matrix-closure engine (txn/closure_jax): packed "
                  "adjacency planes (4, N, N/8) or (B, 4, N, N/8); N "
                  "pow2 >= TXN_N_FLOOR (service cap 4096, offline "
-                 "shrink may go wider), B pow2 (service pads)",
+                 "shrink may go wider), B pow2 (service pads). The "
+                 "sharded form (shard_map over the batch axis, B a "
+                 "pow2 multiple of D) sees the same global shapes; "
+                 "each shard squares B/D adjacency stacks",
             templates=(((four, N, N8),), ((txn_B, four, N, N8),)),
-            axes_doc=(N, txn_B),
+            axes_doc=(N, txn_B, mesh_D),
         ),
     )
     return Inventory(sites=sites, infra_names=INFRA_NAMES)
@@ -387,16 +419,60 @@ def _witness_specs():
         return jax.eval_shape(CJ._jitted(16),
                               st((4, 16, 2), np.uint8))
 
+    def _witness_mesh():
+        # a 1-device mesh: available on every platform, and the D=1
+        # rung keeps the artifact deterministic across environments
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(jax.devices()[:1]), ("batch",))
+
+    def kernel_sharded_witness():
+        from ..checker import pallas_seg as PS
+
+        spec = PS.spec_for(8, 32, 4, 2)
+        assert spec is not None
+        run = PS._sharded_scan_fn(spec, 8, _witness_mesh(), "batch")
+        W = spec.n_words
+        return jax.eval_shape(
+            run, st((1, 2, spec.chunk, 2 + 2 * spec.K)),
+            tuple(st((1, spec.rows, 128)) for _ in range(W)),
+            st((1, 1, 128)), st((1, 8, 128)),
+            st((spec.table_rows_pad, 128)), 32)
+
+    def keys_sharded_witness():
+        from ..checker import linear_jax as LJ
+
+        fn = LJ._sharded_keys_fn(_witness_mesh(), "batch", "keys",
+                                 4, 64, 2, 16, 16)
+        return jax.eval_shape(fn, st((16, 16)), st((8, 4, 2)),
+                              st((8, 4, 2)), st((8, 4)), st((8,)))
+
+    def closure_sharded_witness():
+        from ..txn import closure_jax as CJ
+
+        return jax.eval_shape(
+            CJ._jitted_sharded(16, _witness_mesh()),
+            st((2, 4, 16, 2), np.uint8))
+
     return (
         ("pallas-stream-scan",
          "spec_for(8,32,P=4,K=2), 2 chunks, b_pad=8", kernel_witness),
+        ("pallas-stream-scan",
+         "run_sharded: same spec, D=1 mesh rung",
+         kernel_sharded_witness),
         ("xla-batch-engines",
          "check_device_keys at (ns,nt)=(16,16) S=8 B=4 K=2",
          keys_witness),
         ("xla-batch-engines",
          "check_device_flat at (ns,nt)=(16,16) S=8 B=4 K=2",
          flat_witness),
+        ("xla-batch-engines",
+         "check_device_keys_sharded: same shapes, D=1 mesh rung",
+         keys_sharded_witness),
         ("txn-closure", "closure bucket N=16", closure_witness),
+        ("txn-closure",
+         "closure_diag_kernel_sharded: B=2 N=16, D=1 mesh rung",
+         closure_sharded_witness),
     )
 
 
@@ -489,6 +565,10 @@ def render_programs() -> str:
         f"| txn closure N | pow2 {L['txn_N'][0]}..{L['txn_N'][1]} | "
         f"`txn.edges.TXN_N_FLOOR`, `ServiceLimits.max_txns="
         f"{lim.max_txns}` (service cap; offline shrink may go wider) |",
+        f"| mesh shard axis D | pow2 {L['mesh_D'][0]}.."
+        f"{L['mesh_D'][1]} | `service.sharding.MAX_SHARDS`; per-shard "
+        "shapes are the bucketed global shapes divided by D (both "
+        "pow2, so the division stays on the ladder) |",
         f"| shrink kept-op buckets | pow2 {L['shrink_bucket'][0]}.."
         f"{L['shrink_bucket'][1]} | `shrink.verdicts.MIN_BUCKET` |",
         f"| shrink batch B | pow2 {L['shrink_B'][0]}.."
@@ -571,6 +651,14 @@ SHAPE_SINKS: Dict[str, dict] = {
     "check_device_batch": {"kwargs": ("n_states", "n_transitions")},
     "check_device_pallas_stream": {"kwargs": ("n_states",
                                               "n_transitions")},
+    # mesh sinks: a shard_map body fed a shape not divided from a
+    # declared bucket compiles one per-shard program per seed — B must
+    # be a pow2 multiple of D, table dims pow2, like everywhere else
+    "check_device_keys_sharded": {"kwargs": ("B", "n_states",
+                                             "n_transitions")},
+    "stream_dispatch_sharded": {"kwargs": ("n_states",
+                                           "n_transitions")},
+    "check_sharded": {"kwargs": ("n_states", "n_transitions")},
 }
 
 #: callables that PRODUCE bucketed values

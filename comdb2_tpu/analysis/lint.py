@@ -39,6 +39,13 @@ short story per rule id:
   loop over ``<x>.ops`` inside those modules reintroduces per-op
   Python on the hot path. Op objects are API-edge views only
   (counterexample decode, report rendering — suppression-listed).
+- ``vmap-sharded-oracle`` — ``linear_jax.check_sharded`` (the vmap
+  engine shard_mapped over a mesh) is a TEST ORACLE only: vmap lowers
+  ~20x worse per lane than the flat-batch encodings, so sharding it
+  scales a pessimized program. Production mesh traffic rides the
+  stream/keys/flat sharded engines through ``check_batch``; any
+  non-test call site routing serving traffic back onto the oracle is
+  a finding (round 7 removed the last one).
 """
 
 from __future__ import annotations
@@ -114,6 +121,7 @@ class _ModuleInfo(ast.NodeVisitor):
         self.func_defs: Dict[str, ast.AST] = {}
         self.loop_dispatch: List[Tuple[int, str]] = []
         self.ops_loops: List[int] = []
+        self.vmap_oracle_calls: List[int] = []
         self._fn_depth = 0
         self._loop_depth = 0
 
@@ -217,6 +225,8 @@ class _ModuleInfo(ast.NodeVisitor):
                     (node.lineno, key, self._fn_depth > 0))
         if name in PER_ITEM_DISPATCH_NAMES and self._loop_depth > 0:
             self.loop_dispatch.append((node.lineno, name))
+        if name == "check_sharded":
+            self.vmap_oracle_calls.append(node.lineno)
         if name in PARSE_NAMES:
             self.parse_calls.append(node.lineno)
         if name in CHECKER_ENTRY_NAMES:
@@ -400,6 +410,18 @@ def lint_file(path: str, source: Optional[str] = None, *,
             "referencing independent.wrap_keyed_history — EDN [k v] "
             "values parse as plain tuples (a bare 2-tuple is a cas "
             "pair)"))
+
+    if not in_tests and base != "linear_jax.py":
+        # check_sharded (the vmap-sharded oracle) may be DEFINED in
+        # linear_jax and CALLED from tests; everything else routing
+        # mesh traffic onto it is serving a 20x-pessimized engine
+        for ln in info.vmap_oracle_calls:
+            raw.append(Finding(
+                "vmap-sharded-oracle", path, ln,
+                "check_sharded is a test oracle — vmap lowers ~20x "
+                "worse per lane, so sharding it scales a pessimized "
+                "program; route mesh traffic through checker.batch."
+                "check_batch (stream/keys/flat sharded engines)"))
 
     if not in_tests:
         # tests legitimately compare per-item vs batched results; the

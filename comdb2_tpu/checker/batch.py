@@ -111,7 +111,7 @@ def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
                n_pad: int = 0,
                build_streams: bool = True) -> PackedBatch:
     """Pack histories for :func:`~.linear_jax.check_device_batch` /
-    :func:`~.linear_jax.check_sharded`.
+    :func:`~.linear_jax.check_device_keys_sharded`.
 
     Transition ids are re-interned into one union table so all histories
     share a single memoized model; the BFS depth bound is the max
@@ -372,8 +372,18 @@ def _slice_spec(streams, sizes, p_eff_pad):
                          P, K + (K & 1))
 
 
+def _slice_with_sentinels(streams, start, end, B):
+    """Slice ``streams`` (the B real histories' renamed streams) for
+    ``[start, end)``, appending sentinel empty streams for pad indices
+    >= B. Sentinels are all-padding (every engine yields VALID on
+    them) and are sliced off before any verdict/metric surfaces."""
+    real = streams[start:min(end, B)]
+    return real + [_empty_stream()] * ((end - start) - len(real))
+
+
 def _stream_stage(batch: PackedBatch, succ, sizes, s_pad, k_pad,
-                  p_eff_pad, mesh):
+                  p_eff_pad, mesh, B_pad: Optional[int] = None,
+                  batch_axis: str = "batch"):
     """Stage the streamed-kernel dispatches WITHOUT blocking on
     results. On a cold batch the host segment/remap/pack pass runs
     slice-by-slice, dispatching each slice before building the next —
@@ -383,51 +393,84 @@ def _stream_stage(batch: PackedBatch, succ, sizes, s_pad, k_pad,
     with cached streams (timed bench reruns, capacity escalation) the
     slices dispatch back-to-back from the cache.
 
+    With a >1-device mesh the slices ride the first-class shard_map
+    path: each slice is ONE fused ``stream_dispatch_sharded`` whose
+    per-shard body is the production kernel scan — the host packs
+    slice i+1's tensors while ALL shards run slice i. ``B_pad`` is the
+    sentinel-padded batch width (D | B_pad); sentinel histories are
+    excluded from verdicts and metrics by the caller's ``[:B]`` slice.
+
     Returns ``(pending, segs_list)``: ``pending`` is a list of
-    ``((res, starts), start, end)`` handles for
-    :func:`_stream_collect`, or None when the shape can't run fused —
-    ``segs_list`` is still complete then, so the XLA engines reuse the
-    streams (`segment_batch(streams=...)`)."""
-    devices = (list(mesh.devices.flat) if mesh is not None else None)
-    ndev = len(devices) if devices else 0
-    devs = devices if devices else [None]
+    ``(handle, start, end)`` entries for :func:`_stream_collect`
+    (handle = ``(res, starts)`` single-device or
+    ``(res, starts, D)`` sharded), or None when the shape can't run
+    fused — ``segs_list`` is still complete then, so the XLA engines
+    reuse the streams (`segment_batch(streams=...)`)."""
+    B = len(batch)
+    D = int(mesh.shape[batch_axis]) if mesh is not None else 0
+    cap = min(PSEG.MAX_STREAM_B, PIPELINE_B)
+    if D > 1:
+        B_pad = B_pad if B_pad is not None else max(_next_pow2(B), D)
+        plan = [(s, e, -1) for s, e in
+                PSEG.plan_shard_slices(B_pad, D, max_stream_b=cap)]
+        devs = [None]
+        ndev = 0
+    else:
+        devices = (list(mesh.devices.flat) if mesh is not None
+                   else None)
+        ndev = len(devices) if devices else 0
+        devs = devices if devices else [None]
+        plan = PSEG.plan_stream_slices(B, ndev, max_stream_b=cap)
     cached = getattr(batch, "_stream_seg_cache", None)
     cached = cached[1] if cached is not None \
         and cached[0] == (s_pad, k_pad) else None
-    B = len(batch)
-    plan = PSEG.plan_stream_slices(
-        B, ndev, max_stream_b=min(PSEG.MAX_STREAM_B, PIPELINE_B))
+
+    def dispatch(streams, start, end):
+        spec = _slice_spec(streams, sizes, p_eff_pad)
+        if spec is None:
+            return None
+        if D > 1:
+            res, starts = PSEG.stream_dispatch_sharded(
+                succ, streams, spec, sizes["n_states"],
+                sizes["n_transitions"], mesh, batch_axis=batch_axis)
+            return (res, starts, D)
+        dix = plan_dix.get((start, end), 0)
+        return PSEG.stream_dispatch(
+            succ, streams, spec, sizes["n_states"],
+            sizes["n_transitions"], devs[dix] if ndev else None)
+
+    plan_dix = {(s, e): d for s, e, d in plan}
     pending: list = []
     if cached is not None:
         segs_list, _ = cached
-        for start, end, dix in plan:
-            spec = _slice_spec(segs_list[start:end], sizes, p_eff_pad)
-            if spec is None:
+        for start, end, _dix in plan:
+            handle = dispatch(
+                _slice_with_sentinels(segs_list, start, end, B),
+                start, end)
+            if handle is None:
                 return None, segs_list
-            pending.append((PSEG.stream_dispatch(
-                succ, segs_list[start:end], spec, sizes["n_states"],
-                sizes["n_transitions"],
-                devs[dix] if ndev else None), start, end))
+            pending.append((handle, start, end))
         return pending, segs_list
     all_streams: list = []
     p_eff_all = 1
     dead = False
-    for start, end, dix in plan:
-        streams, pe = _build_streams(batch, range(start, end),
+    for start, end, _dix in plan:
+        streams, pe = _build_streams(batch,
+                                     range(start, min(end, B)),
                                      s_pad=s_pad, k_pad=k_pad)
         all_streams.extend(streams)
         p_eff_all = max(p_eff_all, pe)
         if dead:
             continue            # finish building the cacheable streams
-        spec = _slice_spec(streams, sizes, p_eff_pad)
-        if spec is None:
+        handle = dispatch(
+            streams + [_empty_stream()] * ((end - start)
+                                           - len(streams)),
+            start, end)
+        if handle is None:
             dead = True
             pending = []
             continue
-        pending.append((PSEG.stream_dispatch(
-            succ, streams, spec, sizes["n_states"],
-            sizes["n_transitions"], devs[dix] if ndev else None),
-            start, end))
+        pending.append((handle, start, end))
     batch._stream_seg_cache = ((s_pad, k_pad),
                                (all_streams, p_eff_all))
     if dead:
@@ -438,12 +481,20 @@ def _stream_stage(batch: PackedBatch, succ, sizes, s_pad, k_pad,
 def _stream_collect(pending, B):
     """Block on the staged dispatches in order and merge the
     per-slice verdicts (each ``np.asarray`` waits on that slice's
-    device only)."""
+    device only). ``B`` is the PADDED batch width when the slices were
+    sharded — the caller slices sentinel verdicts off before anything
+    user-visible."""
     rs: list = [None] * B
-    for (res, starts), start, end in pending:
-        res = np.asarray(res)
-        rs[start:end] = PSEG.merge_stream_slice(res, starts,
-                                                end - start)
+    for handle, start, end in pending:
+        if len(handle) == 3:          # sharded: (res, starts, D)
+            res, starts, D = handle
+            out = PSEG.merge_stream_shards(np.asarray(res), starts,
+                                           end - start, D)
+        else:
+            res, starts = handle
+            out = PSEG.merge_stream_slice(np.asarray(res), starts,
+                                          end - start)
+        rs[start:end] = out
     return rs
 
 
@@ -539,7 +590,23 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
     B = len(batch)
     sizes = {"n_states": n_states, "n_transitions": n_transitions}
     D = int(mesh.shape[batch_axis]) if mesh is not None else 1
-    B_pad = -(-B // D) * D  # sharded engines need D | B
+    if D > 1 and (D & (D - 1)):
+        raise ValueError(
+            f"mesh axis {batch_axis!r} must be a power of two (got "
+            f"{D}): per-shard shapes are B_pad/D and must stay inside "
+            "the pow2 program inventory (PROGRAMS.md mesh_D ladder)")
+    # sharded engines need D | B; the pad stays pow2 so per-shard
+    # shapes remain bucketed (B_pad/D is the shape each shard
+    # compiles for — the shard-extended PROGRAMS.md inventory). Pad
+    # lanes are SENTINEL histories, excluded from every verdict and
+    # metric by the [:B] slice — info records the factor so callers
+    # can audit that dead work never surfaces in per-batch totals.
+    B_pad = B
+    if D > 1:
+        B_pad = max(_next_pow2(B), D)
+    if info is not None:
+        info["batch"] = {"b": B, "b_pad": B_pad, "pad": B_pad - B,
+                         "shards": max(D, 1)}
 
     def note(name: str) -> None:
         if info is not None:
@@ -583,13 +650,21 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
             # floors the slot count so a serving layer bucketing by
             # effective concurrency compiles one kernel per bucket
             pending, segs_list = _stream_stage(
-                batch, succ, sizes, s_pad, k_pad, p_eff_pad, mesh)
+                batch, succ, sizes, s_pad, k_pad, p_eff_pad, mesh,
+                B_pad=B_pad, batch_axis=batch_axis)
             prebuilt_streams = segs_list
         if pending is not None:
-            note("stream" if mesh is None else "stream-sharded")
+            # label by the route actually taken: a 1-device mesh rides
+            # the plain single-device stream dispatch, not shard_map
+            note("stream" if D <= 1 else "stream-sharded")
 
             def finalize_stream():
-                rs = _stream_collect(pending, B)
+                # sentinel-pad verdicts (always VALID) are sliced off
+                # HERE, before escalation/metrics — a pad history can
+                # never surface as a verdict, counterexample, or
+                # shrink candidate
+                rs = _stream_collect(pending,
+                                     B_pad if D > 1 else B)[:B]
                 status = np.array([r[0] for r in rs], np.int32)
                 fail_at = np.array([
                     segs_list[b].seg_index[rs[b][1]] if rs[b][1] >= 0
@@ -674,14 +749,17 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
         raise ValueError(
             "batch was packed with build_streams=False; the vmap path "
             "needs the dense step streams")
-    note("vmap" if mesh is None else "vmap-sharded")
-    if mesh is not None:
-        out = LJ.check_sharded(mesh, succ, batch.kind, batch.proc,
-                               batch.tr, F=F, P=P,
-                               batch_axis=batch_axis, **sizes)
-    else:
-        out = LJ.check_device_batch(succ, batch.kind, batch.proc,
-                                    batch.tr, F=F, P=P, **sizes)
+    # vmap is a SINGLE-DEVICE last resort only. The vmap-sharded route
+    # (linear_jax.check_sharded) was removed from the production path:
+    # vmap lowers ~20x worse per lane, so sharding it scales a
+    # pessimized program — check_sharded survives as a test oracle and
+    # the vmap-sharded-oracle analysis rule keeps serving traffic off
+    # it. A mesh caller landing here runs one device and says so.
+    note("vmap")
+    if mesh is not None and info is not None:
+        info["mesh_dropped"] = True
+    out = LJ.check_device_batch(succ, batch.kind, batch.proc,
+                                batch.tr, F=F, P=P, **sizes)
     return lambda: tuple(np.asarray(x) for x in out)
 
 

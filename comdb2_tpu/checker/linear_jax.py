@@ -1426,6 +1426,13 @@ def check_device_keys(succ, inv_proc, inv_tr, ok_proc, depth, *,
 
 # --- batched (independent histories) ---------------------------------------
 
+#: sharded keys/flat dispatches this process — one per
+#: :func:`check_device_keys_sharded` call (ONE fused program covering
+#: every shard); ``scripts/bench_multichip.py`` asserts the
+#: single-dispatch-per-batch discipline on the measured delta, the
+#: way ``txn.closure_jax.DISPATCHES`` is asserted
+DISPATCHES = 0
+
 @functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
                                              "n_transitions"))
 def check_device_batch(succ, kind, proc, tr, *, F: int, P: int,
@@ -1439,25 +1446,18 @@ def check_device_batch(succ, kind, proc, tr, *, F: int, P: int,
     return jax.vmap(fn)(kind, proc, tr)
 
 
-def check_device_keys_sharded(mesh, succ, inv_proc, inv_tr, ok_proc,
-                              depth, *, B: int, F: int, P: int,
-                              n_states: int, n_transitions: int,
-                              batch_axis: str = "batch",
-                              engine: str = "keys"):
-    """shard_map the keys/flat engine over the mesh's batch axis: each
-    device runs its own flat batch of B/D histories — pure data
-    parallelism over ICI, zero cross-device collectives (the device
-    form of ``independent/checker``'s per-key partitioning,
-    ``independent.clj:252-300``; SURVEY §2.5 item 8).
-
-    Round 1 routed every mesh run to the vmapped per-lane engine
-    (~20x worse per lane); this keeps the fast flat engines under
-    sharding. B must be divisible by the mesh axis size (callers pad
-    with dead histories)."""
+@functools.lru_cache(maxsize=64)
+def _sharded_keys_fn(mesh, batch_axis: str, engine: str, B: int,
+                     F: int, P: int, n_states: int,
+                     n_transitions: int):
+    """One NAMED jitted shard_map program per (mesh, engine, shape)
+    class — the compile-surface guard keys observed lowerings by jit
+    name, and an eagerly-applied shard_map would log an anonymous
+    wrapper (same reason ``txn.closure_jax._jitted`` uses a named
+    wrapper). The per-shard body is the keys/flat engine at B/D."""
     from jax.sharding import PartitionSpec as PS
 
     D = mesh.shape[batch_axis]
-    assert B % D == 0, (B, D)
     base = check_device_keys if engine == "keys" else check_device_flat
     fn = functools.partial(base, B=B // D, F=F, P=P, n_states=n_states,
                            n_transitions=n_transitions)
@@ -1478,16 +1478,56 @@ def check_device_keys_sharded(mesh, succ, inv_proc, inv_tr, ok_proc,
         # (which trips on scan carries initialized from constants)
         # is unnecessary
         **check_kw)
-    return sm(succ, inv_proc, inv_tr, ok_proc, depth)
+
+    def check_device_keys_sharded(s, ip, it, op, dp):
+        return sm(s, ip, it, op, dp)
+
+    return jax.jit(check_device_keys_sharded)
+
+
+def check_device_keys_sharded(mesh, succ, inv_proc, inv_tr, ok_proc,
+                              depth, *, B: int, F: int, P: int,
+                              n_states: int, n_transitions: int,
+                              batch_axis: str = "batch",
+                              engine: str = "keys"):
+    """shard_map the keys/flat engine over the mesh's batch axis: each
+    device runs its own flat batch of B/D histories — pure data
+    parallelism over ICI, zero cross-device collectives (the device
+    form of ``independent/checker``'s per-key partitioning,
+    ``independent.clj:252-300``; SURVEY §2.5 item 8).
+
+    Round 1 routed every mesh run to the vmapped per-lane engine
+    (~20x worse per lane); this keeps the fast flat engines under
+    sharding. B must be divisible by the mesh axis size (callers pad
+    with sentinel histories — ``checker.batch`` pads B to a pow2
+    multiple of D so per-shard shapes stay inside the bucketed
+    program inventory)."""
+    global DISPATCHES
+    D = mesh.shape[batch_axis]
+    assert B % D == 0, (B, D)
+    fn = _sharded_keys_fn(mesh, batch_axis, engine, B, F, P,
+                          n_states, n_transitions)
+    DISPATCHES += 1
+    return fn(succ, inv_proc, inv_tr, ok_proc, depth)
 
 
 def check_sharded(mesh, succ, kind, proc, tr, *, F: int, P: int,
                   n_states=None, n_transitions=None,
                   batch_axis: str = "batch"):
-    """Shard a batch of independent histories across a device mesh: the
-    batch axis rides data parallelism over ICI; each device runs whole
-    (sub)histories — no intra-search communication (SURVEY §2.5 item 8).
-    """
+    """TEST ORACLE ONLY — the vmap engine sharded over a device mesh.
+
+    Removed from the production batch path (round 7): vmap lowers ~20x
+    worse per lane than the flat-batch encodings (CLAUDE.md), so
+    sharding it scales a pessimized program; ``check_batch`` routes
+    mesh traffic through the stream/keys/flat sharded engines instead
+    and degrades to SINGLE-device vmap when nothing else fits. This
+    stays as an independent cross-check for the mesh parity suite (a
+    second sharded code path with unrelated lowering). The
+    ``vmap-sharded-oracle`` analysis rule flags any non-test caller.
+
+    The batch axis rides data parallelism over ICI; each device runs
+    whole (sub)histories — no intra-search communication (SURVEY §2.5
+    item 8)."""
     from jax.sharding import NamedSharding, PartitionSpec as Pspec
     batch_sh = NamedSharding(mesh, Pspec(batch_axis))
     repl = NamedSharding(mesh, Pspec())

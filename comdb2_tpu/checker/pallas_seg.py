@@ -108,6 +108,7 @@ def use_interpret(on: bool = True) -> None:
     _chunk_call.cache_clear()
     _chunk_jit.cache_clear()
     _scan_fn.cache_clear()
+    _sharded_scan_fn.cache_clear()
     available.cache_clear()
 
 
@@ -720,6 +721,13 @@ def _build_kernel(spec: SegKernelSpec):
 #: bench_txn diffs closure_jax.DISPATCHES (utils/compile_guard.py)
 MOSAIC_BUILDS = 0
 
+#: streamed-kernel dispatches this process: one per
+#: :func:`stream_dispatch` (single device) and one per
+#: :func:`stream_dispatch_sharded` (ONE fused dispatch covering every
+#: shard of a slice). The mesh parity suite and bench_multichip assert
+#: the single-dispatch-per-shard-per-slice discipline on it.
+DISPATCHES = 0
+
 
 @functools.lru_cache(maxsize=32)
 def _chunk_call(spec: SegKernelSpec, b_pad: int = 8):
@@ -961,6 +969,23 @@ def plan_stream_slices(B: int, n_devices: int,
             for i in range(0, B, group)]
 
 
+def plan_shard_slices(B: int, D: int,
+                      max_stream_b: Optional[int] = None):
+    """Pure slice assignment for the SHARD_MAP stream path: ``B``
+    (a positive multiple of ``D`` — callers pad with sentinel
+    histories) splits into ``[(start, end), ...]`` slices whose width
+    is always a multiple of ``D``. Within a slice, shard ``d`` owns
+    the contiguous sub-range ``[start + d*g, start + (d+1)*g)`` with
+    ``g = (end - start) // D`` — ONE fused dispatch covers all D
+    shards per slice. Per-shard slice width is capped at
+    ``max_stream_b`` (VMEM results-buffer bound)."""
+    cap = MAX_STREAM_B if max_stream_b is None else max_stream_b
+    if D <= 0 or B % D != 0:
+        raise ValueError(f"B={B} must be a positive multiple of D={D}")
+    step = min(cap, max(B // D, 1)) * D
+    return [(i, min(i + step, B)) for i in range(0, B, step)]
+
+
 def merge_stream_slice(res: np.ndarray, starts, n: int):
     """Pure per-slice verdict unpacking: the kernel reports fail
     segments in slice-global coordinates; callers need them history-
@@ -974,6 +999,19 @@ def merge_stream_slice(res: np.ndarray, starts, n: int):
     return out
 
 
+def merge_stream_shards(res: np.ndarray, starts, n: int, D: int):
+    """Pure verdict unpacking for ONE sharded dispatch: ``res`` is the
+    ``(D, b_pad, 128)`` results stack, ``starts[d]`` shard d's
+    per-history stream offsets. Returns the slice's ``n`` verdicts in
+    slice order (shard d owns the contiguous sub-range
+    ``[d*g, (d+1)*g)``, matching :func:`plan_shard_slices`)."""
+    g = n // D
+    out = []
+    for d in range(D):
+        out.extend(merge_stream_slice(res[d], starts[d], g))
+    return out
+
+
 def stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
                     device=None):
     """Dispatch one streamed kernel call asynchronously (optionally
@@ -984,6 +1022,7 @@ def stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
     import jax
     import jax.numpy as jnp
 
+    global DISPATCHES
     B = len(segs_list)
     b_pad = 8                 # pow2 buckets bound kernel recompiles
     while b_pad < B:
@@ -1002,7 +1041,106 @@ def stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
     run = _scan_fn(spec, b_pad=b_pad, stream=True)
     _, _, res = run(args[0], tuple(args[1:1 + W]), *args[1 + W:],
                     n_transitions)
+    DISPATCHES += 1
     return res, starts
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_scan_fn(spec: SegKernelSpec, b_pad: int, mesh,
+                     batch_axis: str):
+    """shard_map-wrapped streamed scan: ONE jitted program
+    (``run_sharded`` — the name the compile-surface guard keys on)
+    whose per-shard body is the SAME fused kernel scan as the
+    single-device path (``_scan_fn`` → ``_chunk_call``, so the Mosaic
+    program is compiled once and shared — MOSAIC_BUILDS must not grow
+    with D). Pure data parallelism over the mesh's batch axis: zero
+    cross-shard collectives, each shard streams whole histories. The
+    carry buffers (frontier words, stat row, results) are DONATED so a
+    rerun/escalation resumes in place per shard without a second
+    buffer allocation."""
+    import jax
+    from jax.sharding import PartitionSpec as P_
+
+    if hasattr(jax, "shard_map"):                    # jax >= 0.6
+        shard_map, check_kw = jax.shard_map, {"check_vma": False}
+    else:                                            # 0.4.x spelling
+        from jax.experimental.shard_map import shard_map
+        check_kw = {"check_rep": False}
+    run = _scan_fn(spec, b_pad=b_pad, stream=True)
+    W = spec.n_words
+
+    def body(seg, ws, stat, res, table, stride):
+        out_ws, out_stat, out_res = run(
+            seg[0], tuple(w[0] for w in ws), stat[0], res[0], table,
+            stride)
+        return (tuple(w[None] for w in out_ws), out_stat[None],
+                out_res[None])
+
+    sh = P_(batch_axis)
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(sh, tuple(sh for _ in range(W)), sh, sh, P_(),
+                  P_()),
+        out_specs=(tuple(sh for _ in range(W)), sh, sh),
+        # no collectives anywhere in the kernel scan — each shard is a
+        # closed computation (same reasoning as
+        # linear_jax.check_device_keys_sharded)
+        **check_kw)
+
+    def run_sharded(seg, ws, stat, res, table, stride):
+        return sm(seg, ws, stat, res, table, stride)
+
+    return jax.jit(run_sharded, donate_argnums=(1, 2, 3))
+
+
+def stream_dispatch_sharded(succ, segs_list, spec, n_states,
+                            n_transitions, mesh,
+                            batch_axis: str = "batch"):
+    """Dispatch ONE fused sharded kernel call for a slice of B
+    histories split D ways over ``mesh``'s ``batch_axis`` (B % D == 0
+    — callers pad with sentinel histories; see
+    :func:`plan_shard_slices` for the shard sub-range layout). Every
+    shard runs the per-shard single-dispatch discipline: its whole
+    sub-range rides one kernel scan inside the one fused program.
+    Returns ``(res, starts)`` with ``res`` the ``(D, b_pad, 128)``
+    device results stack and ``starts`` the per-shard stream offsets —
+    decode with :func:`merge_stream_shards`. The caller owns the
+    readback (``np.asarray``), so slice i+1's host pack overlaps this
+    slice's device run exactly like the single-device path."""
+    global DISPATCHES
+    import jax.numpy as jnp
+
+    D = int(mesh.shape[batch_axis])
+    B = len(segs_list)
+    if D <= 0 or B % D != 0:
+        raise ValueError(f"B={B} must be a multiple of D={D}")
+    g = B // D
+    b_pad = 8                 # pow2 buckets bound kernel recompiles
+    while b_pad < g:
+        b_pad *= 2
+    packs = [pack_stream(segs_list[d * g:(d + 1) * g], spec)
+             for d in range(D)]
+    # histories differ in segment count, so shard chunk stacks pad to
+    # a common scan length with dead segments (ok_proc = -1: no-ops)
+    n_chunks = max(c.shape[0] for c, _ in packs)
+    chunks = np.zeros((D, n_chunks) + packs[0][0].shape[1:], np.int32)
+    chunks[:, :, :, 0] = -1
+    for d, (c, _) in enumerate(packs):
+        chunks[d, :c.shape[0]] = c
+    starts = [s for _, s in packs]
+    ws0 = initial_frontier(spec)
+    ws = tuple(jnp.asarray(np.broadcast_to(w, (D,) + w.shape).copy())
+               for w in ws0)
+    stat = jnp.asarray(np.broadcast_to(_init_stat(),
+                                       (D, 1, LANES)).copy())
+    res = jnp.asarray(np.zeros((D, b_pad, LANES), np.int32))
+    table = jnp.asarray(pack_table(succ[:n_states, :n_transitions],
+                                   spec.table_rows_pad))
+    run = _sharded_scan_fn(spec, b_pad, mesh, batch_axis)
+    _, _, out_res = run(jnp.asarray(chunks), ws, stat, res, table,
+                        n_transitions)
+    DISPATCHES += 1
+    return out_res, starts
 
 
 def _prepare(succ, segs, n_states, n_transitions, P):

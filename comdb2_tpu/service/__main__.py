@@ -63,6 +63,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="device frontier capacity F")
     p.add_argument("--batch-cap", type=int, default=64,
                    help="max live requests per device dispatch")
+    p.add_argument("--shards", type=int, default=1,
+                   help="shard-placement axis: shard every bucket "
+                        "dispatch D ways over a device mesh (the "
+                        "batch axis pads to a pow2 multiple of D; "
+                        "1 = single-device path, no mesh)")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission cap; beyond it requests get "
                         "explicit overload replies")
@@ -105,7 +110,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         F=args.frontier, batch_cap=args.batch_cap,
         max_queue=args.max_queue, limits=limits,
         inject_dispatch_latency_s=args.inject_dispatch_latency_ms
-        / 1e3)
+        / 1e3, shards=args.shards)
     daemon = VerifierDaemon(core, host=args.host, port=args.port,
                             coalesce_s=args.coalesce_ms / 1e3,
                             pmux_port=args.pmux,
@@ -118,7 +123,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         primed = core.prime(DEFAULT_PRIME)
     print(json.dumps({"ready": True, "host": daemon.host,
                       "port": daemon.port, "backend": backend,
-                      "model": args.model, "primed": primed}),
+                      "model": args.model, "shards": args.shards,
+                      "primed": primed}),
           flush=True)
     daemon.run()
     return 0

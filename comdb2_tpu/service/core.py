@@ -75,6 +75,7 @@ class _BucketStats:
     batched: int = 0          # live (non-padding) requests dispatched
     compiles: int = 0         # first sighting of a program key
     occupancy_sum: float = 0.0  # live/B_prog per dispatch
+    shard_fill_sum: float = 0.0  # occupied shard slots / D per dispatch
     device_s: float = 0.0
     programs: set = field(default_factory=set)
 
@@ -89,7 +90,8 @@ class VerifierCore:
                  batch_cap: int = 64, max_queue: int = 256,
                  limits: Optional[ServiceLimits] = None,
                  max_host_configs: int = 1 << 20,
-                 inject_dispatch_latency_s: float = 0.0):
+                 inject_dispatch_latency_s: float = 0.0,
+                 shards: int = 1):
         from ..models.model import MODELS
 
         if model not in MODELS:
@@ -101,6 +103,26 @@ class VerifierCore:
         self.max_queue = max_queue
         self.limits = limits or ServiceLimits()
         self.max_host_configs = max_host_configs
+        # shard-placement axis: every bucket dispatch fills D shard
+        # slots (batch axis padded to a pow2 multiple of D) and rides
+        # the shard_map engines over a device mesh. D=1 is the plain
+        # single-device path — no mesh is ever built.
+        from .sharding import MAX_SHARDS, make_mesh
+
+        self.shards = max(int(shards), 1)
+        if self.shards > MAX_SHARDS:
+            raise ValueError(
+                f"shards={shards} exceeds the declared shard-axis "
+                f"ceiling MAX_SHARDS={MAX_SHARDS}")
+        if self.shards & (self.shards - 1):
+            # fail at STARTUP: the engines reject non-pow2 meshes per
+            # dispatch, which the tick's blanket except would turn
+            # into 100% unknown replies on a daemon that looked ready
+            raise ValueError(
+                f"shards={shards} must be a power of two — per-shard "
+                "shapes are bucket/D and must stay pow2 (PROGRAMS.md "
+                "mesh_D ladder)")
+        self.mesh = make_mesh(self.shards) if self.shards > 1 else None
         # benchmarking/testing knob: sleep this long per DEVICE
         # dispatch, modeling the tunneled TPU's ~100 ms
         # dispatch+readback round-trip when the daemon runs on CPU —
@@ -331,7 +353,8 @@ class VerifierCore:
                 from ..shrink import TxnShrinker
 
                 job = TxnShrinker(ops, realtime=realtime,
-                                  round_cap=round_cap)
+                                  round_cap=round_cap,
+                                  mesh=self.mesh)
             else:
                 from ..shrink import Shrinker
 
@@ -347,7 +370,8 @@ class VerifierCore:
                 job = Shrinker(ops, MODELS[model](), F=self.F,
                                engine=self.engine,
                                max_batch=self.batch_cap,
-                               round_cap=round_cap)
+                               round_cap=round_cap,
+                               mesh=self.mesh)
         except (ValueError, RuntimeError) as e:
             # includes MemoOverflow and malformed histories: the
             # tri-state's honest answer, same as the check kind
@@ -538,7 +562,11 @@ class VerifierCore:
 
         t0 = time.monotonic()
         packeds = [p.packed for p in items]
-        b_prog = _next_pow2(len(packeds))
+        # the batch axis fills D shard slots per dispatch: pow2 AND a
+        # multiple of the shard count, so every shard compiles the
+        # same per-shard program (b_prog/D) and no dispatch leaves a
+        # shard slot shapeless
+        b_prog = max(_next_pow2(len(packeds)), self.shards)
         packeds = packeds + [packeds[0]] * (b_prog - len(packeds))
         info: dict = {}
         try:
@@ -548,6 +576,7 @@ class VerifierCore:
             nt = _next_pow2(batch.memo.n_transitions)
             fin = check_batch_async(
                 batch, F=self.F, engine=self.engine, info=info,
+                mesh=self.mesh,
                 s_pad=bucket.S, k_pad=bucket.K,
                 n_states_pad=ns, n_transitions_pad=nt,
                 p_eff_pad=bucket.P_eff)
@@ -580,6 +609,12 @@ class VerifierCore:
             bs.dispatches += 1
             bs.batched += len(items)
             bs.occupancy_sum += len(items) / b_prog
+            if self.shards > 1:
+                from .sharding import shard_fill
+
+                fills = shard_fill(len(items), b_prog, self.shards)
+                bs.shard_fill_sum += (
+                    sum(1 for f in fills if f > 0) / self.shards)
             # stage duration + finalize wait for THIS dispatch only:
             # under the tick loop's double buffer, wall time between
             # stage and finish belongs to the NEXT bucket's host pack
@@ -626,10 +661,11 @@ class VerifierCore:
 
         t0 = time.monotonic()
         adjs = [p.packed.padded(bucket.N) for p in items]
-        b_prog = _next_pow2(len(adjs))
+        # same shard-slot fill as the check kind: D | b_prog, pow2
+        b_prog = max(_next_pow2(len(adjs)), self.shards)
         adjs = adjs + [adjs[0]] * (b_prog - len(adjs))
         try:
-            diag = closure_diag_batch(np.stack(adjs))
+            diag = closure_diag_batch(np.stack(adjs), mesh=self.mesh)
         except Exception as e:                  # noqa: BLE001
             self.m["engine_errors"] += 1
             for p in items:
@@ -645,6 +681,12 @@ class VerifierCore:
         bs.dispatches += 1
         bs.batched += len(items)
         bs.occupancy_sum += len(items) / b_prog
+        if self.shards > 1:
+            from .sharding import shard_fill
+
+            fills = shard_fill(len(items), b_prog, self.shards)
+            bs.shard_fill_sum += (
+                sum(1 for f in fills if f > 0) / self.shards)
         bs.device_s += time.monotonic() - t0
         if pk in self._programs:
             self.m["program_hits"] += 1
@@ -780,6 +822,13 @@ class VerifierCore:
                 if bs.dispatches else 0.0,
                 "device_s": round(bs.device_s, 3),
             }
+            if self.shards > 1:
+                # fraction of the D shard slots holding at least one
+                # live request, averaged over dispatches — the shard-
+                # placement quality metric
+                buckets[key]["shard_fill"] = round(
+                    bs.shard_fill_sum / bs.dispatches, 4) \
+                    if bs.dispatches else 0.0
         return {
             **self.m,
             "injected_dispatch_latency_ms":
@@ -788,6 +837,7 @@ class VerifierCore:
             "queue_depth": len(self.queue),
             "model": self.model,
             "engine": self.engine,
+            "shards": self.shards,
             "frontier": self.F,
             "batch_cap": self.batch_cap,
             "max_queue": self.max_queue,

@@ -1,39 +1,67 @@
-"""Device meshes and sharded checking — the distributed execution
-surface of the service layer (moved here from the former
-``comdb2_tpu.parallel`` stub when the serving subsystem grew around
-it; that name remains as a deprecation shim).
+"""Device meshes and the shard-placement axis of the serving layer.
 
-Histories are packed on host and shipped to device once per analysis;
-independent keys/histories shard across ICI as pure data parallelism
-(each device checks whole (sub)histories — no intra-search
-communication); multi-host DCN only shards more histories. The
-verifier daemon (:mod:`.daemon`) can hand a mesh-backed
-``check_batch`` the same bucketed batches it builds for one chip.
+One daemon feeds N chips: the tick loop's bucketed batches gain a
+shard axis — every dispatch fills ``D`` shard slots per bucket
+(``VerifierCore(shards=D)`` pads the batch axis to a pow2 multiple of
+D), ``check_batch``/``closure_diag_batch`` shard_map the batch axis
+over the mesh (the fused Pallas kernel / closure matmul as the
+per-shard body, zero cross-shard collectives), and the metrics report
+per-shard occupancy. Histories are packed on host and shipped to
+device once per dispatch; independent keys/histories shard across ICI
+as pure data parallelism (each shard checks whole (sub)histories);
+multi-host DCN only shards more histories. ``shards=1`` (the default)
+is the single-device path, bit-identical and mesh-free.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import numpy as np
 
+#: declared ceiling of the shard-placement axis — the compile-surface
+#: inventory's mesh_D ladder tops out here (a pod slice is 256 chips;
+#: one daemon feeding more than 64 is a new deployment shape, widen
+#: deliberately)
+MAX_SHARDS = 64
+
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
-    """A 1-D device mesh over the first n devices (all by default)."""
+    """A 1-D device mesh over the first n devices (all by default).
+    Asking for more devices than the platform exposes is an error,
+    not a silently smaller mesh."""
     import jax
     from jax.sharding import Mesh
 
     devs = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} shards but only {len(devs)} "
+                "device(s) are visible")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
+
+
+def shard_fill(n_live: int, b_prog: int, D: int) -> List[float]:
+    """Per-shard occupancy of one dispatch: live (non-padding)
+    histories land contiguously (shard d owns rows
+    ``[d*g, (d+1)*g)``, g = b_prog/D — the ``plan_shard_slices``
+    layout), so shard d's fill is the clamped overlap with the first
+    ``n_live`` rows. Pure host arithmetic for the metrics; sums to
+    ``n_live / g``."""
+    g = max(b_prog // max(D, 1), 1)
+    return [min(max(n_live - d * g, 0), g) / g for d in range(D)]
 
 
 def check_histories_sharded(histories, model, mesh=None, F: int = 256,
                             axis: str = "batch"):
     """Check many independent histories with the batch axis sharded
     over a mesh; returns (status, fail_at, n_final) NumPy arrays.
-    Builds the mesh over all local devices when none is given."""
+    Builds the mesh over all local devices when none is given.
+    ``check_batch`` pads the batch axis to a pow2 multiple of the mesh
+    size with SENTINEL histories (excluded from verdicts — no real
+    history is checked twice)."""
     from ..checker.batch import check_batch, pack_batch
 
     histories = list(histories)
@@ -42,14 +70,9 @@ def check_histories_sharded(histories, model, mesh=None, F: int = 256,
         return (np.zeros(0, np.int32), np.zeros(0, np.int64),
                 np.zeros(0, np.int32))
     mesh = mesh if mesh is not None else make_mesh(axis=axis)
-    # the batch axis must divide evenly across mesh devices; pad with
-    # copies of the first history and slice the results back
-    n_dev = mesh.devices.size
-    pad = (-n) % n_dev
-    batch = pack_batch(histories + [histories[0]] * pad, model)
-    status, fail_at, n_final = check_batch(batch, F=F, mesh=mesh,
-                                           batch_axis=axis)
-    return status[:n], fail_at[:n], n_final[:n]
+    batch = pack_batch(histories, model)
+    return check_batch(batch, F=F, mesh=mesh, batch_axis=axis)
 
 
-__all__ = ["make_mesh", "check_histories_sharded"]
+__all__ = ["MAX_SHARDS", "check_histories_sharded", "make_mesh",
+           "shard_fill"]

@@ -366,7 +366,7 @@ def minimize(history, *, checker: str = "linear",
     if checker == "txn":
         from .txn import TxnShrinker
 
-        job = TxnShrinker(history, realtime=realtime)
+        job = TxnShrinker(history, realtime=realtime, mesh=mesh)
     elif checker == "linear":
         job = Shrinker(history, model, F=F, engine=engine, mesh=mesh,
                        max_states=max_states)

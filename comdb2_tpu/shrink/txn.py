@@ -47,13 +47,15 @@ class TxnShrinker(DdminEngine):
                  realtime: bool = False,
                  graph: Optional[TxnGraph] = None,
                  max_batch: int = 64,
-                 round_cap: Optional[int] = None):
+                 round_cap: Optional[int] = None,
+                 mesh=None):
         super().__init__(round_cap)
         self.ops_list = list(history)
         self.realtime = realtime
         self.graph = graph if graph is not None \
             else infer_edges(self.ops_list, realtime=realtime)
         self.max_batch = max_batch
+        self.mesh = mesh
         self.extra: dict = {}
 
     # -- candidate plumbing --------------------------------------------
@@ -91,7 +93,8 @@ class TxnShrinker(DdminEngine):
                         for i in chunk]
                 b = next_pow2(len(adjs))
                 adjs = adjs + [adjs[0]] * (b - len(adjs))
-                diag = closure_diag_batch(np.stack(adjs))
+                diag = closure_diag_batch(np.stack(adjs),
+                                          mesh=self.mesh)
                 out[chunk] = np.asarray(diag)[:len(chunk)].any(
                     axis=(1, 2))
                 self.counters["dispatches"] = (

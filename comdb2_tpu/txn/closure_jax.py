@@ -104,6 +104,42 @@ def _jitted(n: int):
     return fn
 
 
+def _jitted_sharded(n: int, mesh, batch_axis: str = "batch"):
+    """The batched closure with its batch axis shard_mapped over
+    ``mesh`` — per-shard body = the SAME ``_diag_kernel`` at B/D, so
+    every batched txn surface scales by dispatch width without a new
+    engine. Named wrapper (``closure_diag_kernel_sharded``) for the
+    compile-surface guard; one program per (N bucket, mesh) counted in
+    ``COMPILES`` like the single-device entries."""
+    global COMPILES
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    key = (n, mesh, batch_axis)
+    fn = _JITTED.get(key)
+    if fn is None:
+        if hasattr(jax, "shard_map"):                # jax >= 0.6
+            shard_map, check_kw = jax.shard_map, {"check_vma": False}
+        else:                                        # 0.4.x spelling
+            from jax.experimental.shard_map import shard_map
+            check_kw = {"check_rep": False}
+        sm = shard_map(
+            lambda planes: _diag_kernel(planes, n=n),
+            mesh=mesh, in_specs=(PS(batch_axis),),
+            out_specs=PS(batch_axis),
+            # no collectives: each shard's closure is a closed
+            # computation over its own adjacency stack
+            **check_kw)
+
+        def closure_diag_kernel_sharded(planes):
+            return sm(planes)
+
+        fn = jax.jit(closure_diag_kernel_sharded)
+        _JITTED[key] = fn
+        COMPILES += 1
+    return fn
+
+
 def _pack(adj: np.ndarray) -> np.ndarray:
     return np.packbits(adj.astype(np.uint8), axis=-1)
 
@@ -119,12 +155,33 @@ def closure_diag(adj: np.ndarray) -> np.ndarray:
     return np.asarray(out)
 
 
-def closure_diag_batch(adjs: np.ndarray) -> np.ndarray:
+def closure_diag_batch(adjs: np.ndarray, mesh=None,
+                       batch_axis: str = "batch") -> np.ndarray:
     """(B, 4, N, N) bool -> (B, 3, N) bool. ONE dispatch for the whole
     batch — the service's coalesced path (B pow2-padded by the
-    caller)."""
+    caller). With a >1-device ``mesh`` the batch axis shard_maps over
+    it (pure data parallelism; still ONE dispatch): B pads to a pow2
+    multiple of D with all-zero adjacencies — acyclic by construction,
+    their diagonals read all-False and are sliced off before return,
+    so a pad graph can never surface as a verdict."""
     global DISPATCHES
     n = adjs.shape[-1]
+    B = adjs.shape[0]
+    D = int(mesh.shape[batch_axis]) if mesh is not None else 1
+    if D > 1:
+        from ..utils import next_pow2
+
+        if D & (D - 1):
+            raise ValueError(
+                f"mesh axis {batch_axis!r} must be a power of two "
+                f"(got {D}) — per-shard shapes must stay pow2")
+        b_pad = max(next_pow2(B), D)
+        if b_pad != B:
+            pad = np.zeros((b_pad - B,) + adjs.shape[1:], adjs.dtype)
+            adjs = np.concatenate([adjs, pad])
+        out = _jitted_sharded(n, mesh, batch_axis)(_pack(adjs))
+        DISPATCHES += 1
+        return np.asarray(out)[:B]
     out = _jitted(n)(_pack(adjs))
     DISPATCHES += 1
     return np.asarray(out)

@@ -205,6 +205,15 @@ if [ "$RC_MIN" -ne 1 ]; then
 fi
 rm -rf "$SHRINK_STORE"
 
+stage multichip "multichip dryrun (8-device CPU mesh, interpret kernel)"
+# the full sharded checking step on the forced 8-device CPU mesh:
+# shard_map stream path (fused kernel in interpret mode), kernel/XLA
+# bit-parity, escalation on one shard, in-place ladder, wide-P — the
+# same gate MULTICHIP_r0N.json records (runs in a subprocess so the
+# corrected env lands before any jax import)
+run env JAX_PLATFORMS=cpu python -c \
+    "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
 stage service-smoke "verifier service smoke (CPU backend)"
 # zombie baseline BEFORE the daemon runs: the post-shutdown check
 # below must catch NEW zombies (a reaped child can't show Z, so the
@@ -267,5 +276,6 @@ if [ "$JSON_MODE" = 0 ]; then
     echo "OK: checker clean, ASan build clean, native static" \
          "analysis clean, ct_pmux shutdown clean, txn smoke caught" \
          "the seeded cycle, shrink smoke reached the known minimum," \
+         "multichip dryrun bit-identical across the mesh," \
          "verifier service shutdown clean"
 fi

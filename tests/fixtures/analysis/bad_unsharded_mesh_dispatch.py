@@ -1,0 +1,17 @@
+"""Seeded violation: a shard_map dispatch site fed shapes not divided
+from a declared bucket. The mesh sinks compile ONE per-shard program
+per (B/D, table dims) class — a raw ``len(...)`` batch width or raw
+memo counts make every distinct traffic shape a fresh per-shard
+program, multiplied by the mesh size."""
+
+from comdb2_tpu.checker import linear_jax as LJ
+
+
+def check_mesh(mesh, memo, succ, sb, histories):
+    # BUG: raw len(...) as the sharded batch width AND raw memo
+    # counts as the table dims — nothing here is drawn from a pow2
+    # ladder, so the shard-map body compiles per seed
+    return LJ.check_device_keys_sharded(
+        mesh, succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+        B=len(histories), F=128, P=4,
+        n_states=memo.n_states, n_transitions=memo.n_transitions)

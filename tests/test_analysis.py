@@ -42,6 +42,8 @@ FIXTURE_RULES = {
     "bad_pallas_k9.py": "pallas-k-cap",
     "bad_unbucketed_shape.py": "jaxpr-unbucketed-shape",
     "bad_unbucketed_dispatch.py": "unbucketed-dispatch-site",
+    "bad_unsharded_mesh_dispatch.py": "unbucketed-dispatch-site",
+    "bad_vmap_sharded_route.py": "vmap-sharded-oracle",
     "bad_stale_suppression.py": "stale-suppression",
 }
 
