@@ -46,6 +46,16 @@ short story per rule id:
   stream/keys/flat sharded engines through ``check_batch``; any
   non-test call site routing serving traffic back onto the oracle is
   a finding (round 7 removed the last one).
+- ``raw-clock-in-pipeline`` — ``time.time()``/``time.monotonic()``/
+  ``time.perf_counter()`` read directly inside a dispatch-pipeline
+  module (service/shrink/txn packages, checker ``linear.py``/
+  ``batch.py``/``pallas_seg.py``). Timing there must go through
+  ``comdb2_tpu.obs.trace`` (``monotonic()``, the span API): the
+  per-request stage attribution (queue-wait / host-pack / device /
+  finalize) only tiles the measured wall when every timestamp comes
+  off ONE clock, and a raw ``time.time()`` (wall clock, steppable by
+  the clock nemesis) silently corrupts device-time attribution.
+  ``comdb2_tpu/obs`` itself and tests are exempt.
 """
 
 from __future__ import annotations
@@ -82,6 +92,15 @@ PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device",
 PACK_SEGMENT_MODULES = {"packed.py", "columnar.py",
                         "synth_columnar.py", "batch.py",
                         "linear_jax.py", "pallas_seg.py"}
+
+#: the dispatch-pipeline scope of ``raw-clock-in-pipeline``: package
+#: directories plus the checker dispatch modules (files whose
+#: basename contains "dispatch" are included so the seeded fixture
+#: and future dispatch helpers are covered); ``obs`` is the clock's
+#: home and exempt
+RAW_CLOCK_DIRS = {"service", "shrink", "txn"}
+RAW_CLOCK_FILES = {"linear.py", "batch.py", "pallas_seg.py"}
+RAW_CLOCK_FNS = {"time", "monotonic", "perf_counter"}
 
 
 def _name_of(node: ast.AST) -> str:
@@ -122,6 +141,9 @@ class _ModuleInfo(ast.NodeVisitor):
         self.loop_dispatch: List[Tuple[int, str]] = []
         self.ops_loops: List[int] = []
         self.vmap_oracle_calls: List[int] = []
+        self.clock_calls: List[Tuple[int, str]] = []
+        self._time_modnames: set = set()   # `import time [as x]`
+        self._time_aliases: set = set()    # `from time import ...`
         self._fn_depth = 0
         self._loop_depth = 0
 
@@ -144,6 +166,8 @@ class _ModuleInfo(ast.NodeVisitor):
                     self.jax_import_line = node.lineno
             if top == "multiprocessing":
                 self.mp_imports.append((node.lineno, a.name))
+            if a.name == "time":
+                self._time_modnames.add(a.asname or "time")
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -158,6 +182,10 @@ class _ModuleInfo(ast.NodeVisitor):
             for a in node.names:
                 if a.name == "ProcessPoolExecutor":
                     self.mp_imports.append((node.lineno, a.name))
+        if node.module == "time":
+            for a in node.names:
+                if a.name in RAW_CLOCK_FNS:
+                    self._time_aliases.add(a.asname or a.name)
         self.generic_visit(node)
 
     # -- defs / scoping ------------------------------------------------
@@ -225,6 +253,12 @@ class _ModuleInfo(ast.NodeVisitor):
                     (node.lineno, key, self._fn_depth > 0))
         if name in PER_ITEM_DISPATCH_NAMES and self._loop_depth > 0:
             self.loop_dispatch.append((node.lineno, name))
+        if isinstance(fn, ast.Attribute) and fn.attr in RAW_CLOCK_FNS \
+                and _name_of(fn.value) in self._time_modnames:
+            self.clock_calls.append(
+                (node.lineno, f"{_name_of(fn.value)}.{fn.attr}"))
+        elif isinstance(fn, ast.Name) and fn.id in self._time_aliases:
+            self.clock_calls.append((node.lineno, fn.id))
         if name == "check_sharded":
             self.vmap_oracle_calls.append(node.lineno)
         if name in PARSE_NAMES:
@@ -436,6 +470,25 @@ def lint_file(path: str, source: Optional[str] = None, *,
                 "pack_batch/check_batch (shrink candidates: shrink."
                 "verdicts.check_candidates) or submit them to the "
                 "comdb2_tpu.service verifier daemon"))
+
+    # dispatch-pipeline scope: the service/shrink/txn packages, the
+    # checker dispatch modules, and any "dispatch"-named file (the
+    # fixture hook); obs owns the clock, tests drive deadlines with
+    # whatever clock they like
+    in_pipeline = (not in_tests and "obs" not in parts
+                   and ((set(parts) & RAW_CLOCK_DIRS
+                         and "comdb2_tpu" in parts)
+                        or base in RAW_CLOCK_FILES
+                        or "dispatch" in base))
+    if in_pipeline:
+        for ln, what in info.clock_calls:
+            raw.append(Finding(
+                "raw-clock-in-pipeline", path, ln,
+                f"{what}() read directly in a dispatch-pipeline "
+                "module — route timing through comdb2_tpu.obs.trace "
+                "(monotonic()/span()): stage sums only tile the "
+                "measured wall when every timestamp shares ONE "
+                "monotonic clock (docs/observability.md)"))
 
     if base in PACK_SEGMENT_MODULES or "pack" in base:
         for ln in info.ops_loops:

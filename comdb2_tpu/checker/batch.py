@@ -17,11 +17,25 @@ import numpy as np
 
 from ..models.memo import MemoizedModel, memoize_model, transitions_of
 from ..models.model import Model
+from ..obs import trace as _obs
 from ..ops.op import FAIL, INVOKE, OK, Op
 from ..ops.packed import PackedHistory, pack_history
 from ..utils import next_pow2 as _next_pow2
 from . import linear_jax as LJ
 from . import pallas_seg as PSEG
+
+#: device->host verdict readback per history: status int32 + fail
+#: index int64 + final count int32 (transfer-byte accounting — the
+#: h2d side is summed from the actual staged tensors)
+_D2H_BYTES_PER_LANE = 16
+
+
+def _stream_nbytes(streams) -> int:
+    """Host->device bytes of a list of SegmentStreams (the streamed
+    kernel's per-slice payload)."""
+    return sum(int(s.inv_proc.nbytes) + int(s.inv_tr.nbytes)
+               + int(s.ok_proc.nbytes) + int(s.depth.nbytes)
+               for s in streams)
 
 
 @dataclass
@@ -105,6 +119,7 @@ def _segments_of(p, s_pad: int = 0, k_pad: int = 0):
         np.pad(segs.depth, (0, ds)))
 
 
+@_obs.traced("batch.pack")
 def pack_batch(histories: Sequence[Union[Sequence[Op], PackedHistory]],
                model: Model,
                max_states: int = 1 << 20,
@@ -204,6 +219,7 @@ class SegmentBatch:
     depth: np.ndarray      # int32[S] — max pending depth across lanes
 
 
+@_obs.traced("batch.segments")
 def segment_batch(batch: PackedBatch,
                   streams: Optional[list] = None,
                   s_pad: int = 0, k_pad: int = 0) -> SegmentBatch:
@@ -250,6 +266,7 @@ def segment_batch(batch: PackedBatch,
     )
 
 
+@_obs.traced("batch.remap")
 def _build_streams(batch: PackedBatch, indices, s_pad: int = 0,
                    k_pad: int = 0):
     """Union-remapped, slot-renamed SegmentStreams for a SUBSET of the
@@ -429,15 +446,18 @@ def _stream_stage(batch: PackedBatch, succ, sizes, s_pad, k_pad,
         spec = _slice_spec(streams, sizes, p_eff_pad)
         if spec is None:
             return None
-        if D > 1:
-            res, starts = PSEG.stream_dispatch_sharded(
+        with _obs.span("batch.dispatch", engine="stream",
+                       start=start, end=end):
+            if D > 1:
+                res, starts = PSEG.stream_dispatch_sharded(
+                    succ, streams, spec, sizes["n_states"],
+                    sizes["n_transitions"], mesh,
+                    batch_axis=batch_axis)
+                return (res, starts, D)
+            dix = plan_dix.get((start, end), 0)
+            return PSEG.stream_dispatch(
                 succ, streams, spec, sizes["n_states"],
-                sizes["n_transitions"], mesh, batch_axis=batch_axis)
-            return (res, starts, D)
-        dix = plan_dix.get((start, end), 0)
-        return PSEG.stream_dispatch(
-            succ, streams, spec, sizes["n_states"],
-            sizes["n_transitions"], devs[dix] if ndev else None)
+                sizes["n_transitions"], devs[dix] if ndev else None)
 
     plan_dix = {(s, e): d for s, e, d in plan}
     pending: list = []
@@ -478,6 +498,7 @@ def _stream_stage(batch: PackedBatch, succ, sizes, s_pad, k_pad,
     return pending, all_streams
 
 
+@_obs.traced("batch.collect")
 def _stream_collect(pending, B):
     """Block on the staged dispatches in order and merge the
     per-slice verdicts (each ``np.asarray`` waits on that slice's
@@ -657,7 +678,16 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
             # label by the route actually taken: a 1-device mesh rides
             # the plain single-device stream dispatch, not shard_map
             note("stream" if D <= 1 else "stream-sharded")
+            if info is not None:
+                # per-dispatch tunnel accounting (docs/observability
+                # .md): the ~25 MB/s link makes bytes a first-class
+                # cost — summed from the actual staged tensors
+                info["transfer_bytes"] = {
+                    "h2d": int(succ.nbytes)
+                    + _stream_nbytes(segs_list),
+                    "d2h": B * _D2H_BYTES_PER_LANE}
 
+            @_obs.traced("batch.finalize")
             def finalize_stream():
                 # sentinel-pad verdicts (always VALID) are sliced off
                 # HERE, before escalation/metrics — a pad history can
@@ -723,6 +753,12 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
         note(engine if mesh is None else engine + "-sharded")
         sb = segment_batch(batch, streams=prebuilt_streams,
                            s_pad=s_pad, k_pad=k_pad)
+        if info is not None:
+            info["transfer_bytes"] = {
+                "h2d": int(succ.nbytes) + int(sb.inv_proc.nbytes)
+                + int(sb.inv_tr.nbytes) + int(sb.ok_proc.nbytes)
+                + int(sb.depth.nbytes),
+                "d2h": B * _D2H_BYTES_PER_LANE}
         if mesh is not None:
             ip, it, op_, dp = _pad_batch_axis(sb, B_pad - B)
             status_d, fail_seg_d, n_final_d = \
@@ -736,6 +772,7 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
                 succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
                 B=B, F=F, P=P, **sizes)
 
+        @_obs.traced("batch.finalize")
         def finalize_xla():
             status = np.asarray(status_d)[:B]
             fail_seg = np.asarray(fail_seg_d)[:B]
@@ -758,9 +795,15 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
     note("vmap")
     if mesh is not None and info is not None:
         info["mesh_dropped"] = True
+    if info is not None:
+        info["transfer_bytes"] = {
+            "h2d": int(succ.nbytes) + int(batch.kind.nbytes)
+            + int(batch.proc.nbytes) + int(batch.tr.nbytes),
+            "d2h": B * _D2H_BYTES_PER_LANE}
     out = LJ.check_device_batch(succ, batch.kind, batch.proc,
                                 batch.tr, F=F, P=P, **sizes)
-    return lambda: tuple(np.asarray(x) for x in out)
+    return _obs.traced("batch.finalize")(
+        lambda: tuple(np.asarray(x) for x in out))
 
 
 def escalation_indices(status: np.ndarray, F: int,

@@ -12,12 +12,12 @@ low-memory abort (``linear.clj:318-326``).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Union
 
 from ..models.memo import MemoOverflow, MemoizedModel, memo as make_memo
 from ..models.model import Model
+from ..obs import trace as _obs
 from ..ops.op import Op
 from ..ops.packed import PackedHistory, pack_history
 from ..utils import next_pow2 as _next_pow2
@@ -54,6 +54,7 @@ class Analysis:
 CHUNKED_S_THRESHOLD = 4096
 
 
+@_obs.traced("linear.analysis")
 def analysis(model: Model,
              history: Union[Sequence[Op], PackedHistory],
              backend: str = "auto",
@@ -77,7 +78,7 @@ def analysis(model: Model,
     model of ``linear/config.clj:374-393``). When given, the device
     path runs chunked.
     """
-    t0 = time.monotonic()
+    t0 = _obs.monotonic()
     packed = (history if isinstance(history, PackedHistory)
               else pack_history(list(history)))
     n = len(packed)
@@ -89,6 +90,8 @@ def analysis(model: Model,
         mm = make_memo(model, packed, max_states=max_states)
     except MemoOverflow as e:
         return Analysis(valid=UNKNOWN, info={"cause": str(e)})
+    # pack+memo attribution for the offline trace (filetest --trace)
+    _obs.record("linear.pack", t0, _obs.monotonic(), n=n, P=P)
 
     if backend == "host" or (backend == "auto" and n < host_threshold):
         return _analyze_host(mm, packed, max_host_configs, t0)
@@ -97,6 +100,7 @@ def analysis(model: Model,
                            progress_interval_s=progress_interval_s)
 
 
+@_obs.traced("linear.host")
 def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
                   max_configs: int, t0: float) -> Analysis:
     try:
@@ -105,7 +109,7 @@ def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
         return Analysis(valid=UNKNOWN, info={"cause": str(e),
                                              "backend": "host"})
     info = {"backend": "host", "max_frontier": r.max_frontier,
-            "time_s": time.monotonic() - t0}
+            "time_s": _obs.monotonic() - t0}
     if r.valid:
         return Analysis(valid=True, final_count=r.final_count, info=info)
     op = packed.ops[r.op_index]
@@ -128,6 +132,7 @@ def _analyze_host(mm: MemoizedModel, packed: PackedHistory,
                     configs=cfgs, info=info)
 
 
+@_obs.traced("linear.device")
 def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
                     capacities: Sequence[int], t0: float,
                     progress=None,
@@ -203,7 +208,7 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
         info["engine"] = "pallas-fused"
         info["frontier_capacity"] = PSEG.F
         if status != LJ.UNKNOWN:
-            info["time_s"] = time.monotonic() - t0
+            info["time_s"] = _obs.monotonic() - t0
             return _device_verdict(mm, packed, segs, status, fail_seg,
                                    n_final, info)
 
@@ -242,7 +247,7 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
         cap_ix = 0
         F = capacities[cap_ix]
         carry = LJ.init_seg_carry(F, P2)
-        t_run = time.monotonic()
+        t_run = _obs.monotonic()
         last = t_run
         done = 0
         visited = 0
@@ -269,7 +274,7 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
             done = end
             if st != LJ.VALID:
                 break
-            now = time.monotonic()
+            now = _obs.monotonic()
             if progress is not None and \
                     now - last >= progress_interval_s:
                 # pending counts from the carry: telemetry parity
@@ -288,7 +293,7 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
         status, fail_seg, n_final = (int(carry[4]), carry[5],
                                      carry[3])
         info["frontier_capacity"] = F
-    info["time_s"] = time.monotonic() - t0
+    info["time_s"] = _obs.monotonic() - t0
     return _device_verdict(mm, packed, segs, status, fail_seg, n_final,
                            info)
 

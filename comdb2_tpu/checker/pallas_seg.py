@@ -46,6 +46,7 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from ..obs import trace as _obs
 from ..utils import next_pow2 as _next_pow2
 
 logger = logging.getLogger(__name__)
@@ -1176,8 +1177,6 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     boundary BEFORE the failure and the number of segments consumed up
     to it — the seed for bounded counterexample reconstruction (decode
     with :func:`decode_frontier`)."""
-    import time
-
     import jax.numpy as jnp
 
     prep = _prepare(succ, segs, n_states, n_transitions, P)
@@ -1188,7 +1187,7 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
     ws = tuple(ws)
     res = jnp.zeros((8, LANES), jnp.int32)       # unused: no RESETs
     s_real = s_real if s_real is not None else segs.ok_proc.shape[0]
-    t_run = time.monotonic()
+    t_run = _obs.monotonic()
     last = t_run
     prev_ws, done = ws, 0
     visited = 0
@@ -1201,7 +1200,7 @@ def check_device_pallas_chunked(succ: np.ndarray, segs, *,
         if int(st[0, 0]) != VALID:
             break
         prev_ws, done = ws, (c + 1) * spec.chunk
-        now = time.monotonic()
+        now = _obs.monotonic()
         if progress is not None and now - last >= progress_interval_s:
             from .linear_jax import estimated_cost
 

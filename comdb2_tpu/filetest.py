@@ -59,10 +59,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="store root for --shrink artifacts (default "
                         "store/ — the run shows up in the store web "
                         "index like any harness run)")
+    p.add_argument("--trace", metavar="PATH",
+                   help="write a Chrome/Perfetto trace-event JSON of "
+                        "this run (the same span pipeline service "
+                        "requests get — parse/pack/device/finalize "
+                        "stage breakdown; docs/observability.md)")
     args = p.parse_args(argv)
     if args.txn:
         args.checker = "txn"
 
+    if args.trace:
+        from .obs import trace as obs_trace
+
+        obs_trace.enable()
+    try:
+        return _run(args)
+    finally:
+        if args.trace:
+            from .obs import trace as obs_trace
+
+            obs_trace.export_chrome(args.trace)
+            print(f"trace: {len(obs_trace.spans())} span(s) -> "
+                  f"{args.trace}", file=sys.stderr)
+            # leave the process as found (embedders run main() too)
+            obs_trace.disable()
+            obs_trace.clear()
+
+
+def _run(args) -> int:
+    """The checker run proper (main owns arg parsing + the trace
+    export, which must happen on EVERY exit path)."""
     if args.service:
         # remote path first: the whole point is NOT to attach this
         # process to a device (the tunnel costs ~100 ms per dispatch;
@@ -137,8 +163,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         ensure_backend()
 
-    with open(args.history) as fh:
-        history = parse_history(fh.read())
+    from .obs import trace as obs_trace
+
+    with obs_trace.span("filetest.parse", path=args.history):
+        with open(args.history) as fh:
+            history = parse_history(fh.read())
 
     if (args.keyed or args.model == "cas-register-comdb2") \
             and args.checker != "txn":

@@ -83,9 +83,17 @@ def _index_html(store_root: str) -> str:
                        f"queue {st.get('queue_depth', 0)}")
         except Exception:
             pass
-        body.append(
-            '<p><a href="/files/service/">verifier service</a>'
-            f"{html.escape(summary)}</p>")
+        links = ['<a href="/files/service/">verifier service</a>']
+        # obs artifacts written by the daemon's artifact pass
+        # (docs/observability.md): the latency/rate timeline and —
+        # with --trace — the Perfetto span export
+        for art in ("timeline.svg", "trace.json"):
+            if os.path.exists(os.path.join(store_root, "service",
+                                           art)):
+                links.append(f'<a href="/files/service/{art}">'
+                             f"{art}</a>")
+        body.append(f"<p>{' · '.join(links)}"
+                    f"{html.escape(summary)}</p>")
     body.append("</body></html>")
     return "".join(body)
 

@@ -96,8 +96,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--store", default=None, metavar="DIR",
                    help="persist status snapshots under DIR/service/ "
                         "(served by the store web browser)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable span tracing (obs.trace): with "
+                        "--store, a Chrome/Perfetto trace.json is "
+                        "written next to the status artifacts; "
+                        "disabled-mode cost is one flag check per "
+                        "span site (docs/observability.md)")
     args = p.parse_args(argv)
 
+    if args.trace:
+        from ..obs import trace as obs_trace
+
+        obs_trace.enable()
     backend = _force_backend(args.backend)
     if args.interpret:
         from ..checker import pallas_seg
@@ -124,7 +134,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(json.dumps({"ready": True, "host": daemon.host,
                       "port": daemon.port, "backend": backend,
                       "model": args.model, "shards": args.shards,
-                      "primed": primed}),
+                      "primed": primed, "trace": args.trace}),
           flush=True)
     daemon.run()
     return 0

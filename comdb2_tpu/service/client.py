@@ -173,6 +173,14 @@ class ServiceClient:
     def status(self) -> dict:
         return self._request({"op": "status"})
 
+    def metrics(self) -> dict:
+        """Scrape the metrics plane (``kind:"metrics"``): one reply
+        carrying both the JSON snapshot (``metrics``) and the
+        Prometheus text form (``prometheus``)."""
+        self._seq += 1
+        return self._request({"op": "check", "kind": "metrics",
+                              "id": self._seq})
+
     def ping(self) -> bool:
         try:
             return bool(self._request({"op": "ping"}).get("pong"))

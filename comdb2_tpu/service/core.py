@@ -31,10 +31,21 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import trace as obs
+from ..obs.metrics import Registry
 from ..utils import next_pow2 as _next_pow2
 from . import protocol
 from .bucketing import (Bucket, ServiceLimits, TxnBucket, bucket_for,
                         txn_bucket_for)
+
+#: the per-request stage names (docs/observability.md): they TILE the
+#: measured wall per request — queue_wait (admission -> dispatch
+#: begin), host_pack (columnar pack/segment/remap + stage), device
+#: (dispatch -> readback complete, including the async overlap window
+#: and any injected tunnel latency), finalize (readback -> reply) —
+#: so scripts/bench_service.py can assert the sum against latency_ms
+STAGES = ("queue_wait_ms", "host_pack_ms", "device_ms",
+          "finalize_ms")
 
 #: (n_events, batch copies) pairs primed at boot — one small and one
 #: mid bucket, each at the serial (B=1) and coalesced (B=cap) program
@@ -59,6 +70,10 @@ class PendingRequest:
     ctx: object = None
     kind: str = "check"
     realtime: bool = False
+    #: per-request stage attribution (STAGES keys, milliseconds) —
+    #: filled along the dispatch path, echoed in the reply and fed to
+    #: the stage histograms
+    stages: dict = field(default_factory=dict)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -81,9 +96,11 @@ class _BucketStats:
 
 
 class VerifierCore:
-    """See module docstring. All times are ``time.monotonic`` floats
-    passed in by the caller — the daemon owns the clock so tests can
-    drive deadlines deterministically."""
+    """See module docstring. All times are monotonic-clock floats
+    (``obs.trace.monotonic`` — the pipeline's one sanctioned clock,
+    rule ``raw-clock-in-pipeline``) passed in by the caller — the
+    daemon owns the clock so tests can drive deadlines
+    deterministically."""
 
     def __init__(self, model: str = "cas-register",
                  engine: str = "auto", F: int = 1024,
@@ -131,10 +148,33 @@ class VerifierCore:
         # in status() so benched numbers can't masquerade as raw.
         self.inject_dispatch_latency_s = inject_dispatch_latency_s
         self.queue: deque = deque()
-        self.t_boot = time.monotonic()
+        self.t_boot = obs.monotonic()
         self._programs: set = set()
         self._latencies: deque = deque(maxlen=2048)
         self._buckets: Dict[str, _BucketStats] = {}
+        # the metrics plane (docs/observability.md): per-core registry
+        # — histograms are fixed-bucket (quantiles without samples),
+        # always on (a handful of integer adds per dispatch); span
+        # TRACING is the separately-gated layer (obs.trace.enable)
+        self.metrics = Registry()
+        self._stage_h = {
+            s: self.metrics.histogram(
+                "service_" + s.replace("_ms", "") + "_ms")
+            for s in STAGES}
+        self._h_latency = self.metrics.histogram("service_latency_ms")
+        self._g_queue = self.metrics.gauge("service_queue_depth")
+        self._c_h2d = self.metrics.counter(
+            "service_transfer_h2d_bytes_total",
+            help="host->device bytes shipped per dispatch (the ~25 "
+                 "MB/s tunnel is a dominant cost)")
+        self._c_d2h = self.metrics.counter(
+            "service_transfer_d2h_bytes_total")
+        # per-request rows + overload/deadline/degrade event marks for
+        # the timeline SVG (report/service_svg.py); bounded deques —
+        # rendering wants the recent window, not unbounded history
+        self._timeline: deque = deque(maxlen=4096)
+        self._events: deque = deque(maxlen=1024)
+        self._priming = False
         self.m: Dict[str, int] = {
             "accepted": 0, "completed": 0, "overloads": 0,
             "bad_requests": 0, "malformed": 0, "deadline_expired": 0,
@@ -148,16 +188,29 @@ class VerifierCore:
     def submit(self, req: dict, now: float, ctx: object = None):
         """Admit one ``check`` request. Returns ``(pending, reply)``:
         exactly one is non-None — an immediate ``reply`` (overload,
-        bad-request, trivial, malformed) or a queued ``pending``."""
+        bad-request, trivial, malformed, metrics) or a queued
+        ``pending``."""
         rid = req.get("id")
+        if req.get("kind") == "metrics":
+            # the scrape answers AHEAD of backpressure: the metrics
+            # plane must work exactly when the queue is full — it
+            # never queues, never dispatches
+            return None, self.metrics_reply(rid)
         if len(self.queue) >= self.max_queue:
             # backpressure BEFORE parse: shedding load must stay O(1)
             # — and before the kind split, so txn requests answer
             # overload exactly like check requests
             self.m["overloads"] += 1
+            self._event("overload", now)
             return None, protocol.error_reply(
                 protocol.OVERLOAD,
                 f"admission queue at cap ({self.max_queue})", rid)
+        with obs.span("admission", rid=rid,
+                      kind=req.get("kind", "check")):
+            return self._admit(req, now, ctx, rid)
+
+    def _admit(self, req: dict, now: float, ctx: object, rid):
+        """Parse/pack/bucket under the admission span (see submit)."""
         kind = req.get("kind", "check")
         if kind == "txn":
             return self._submit_txn(req, now, ctx, rid)
@@ -441,9 +494,10 @@ class VerifierCore:
     def tick(self, now: Optional[float] = None):
         """Expire, drain, coalesce, dispatch. Returns the completed
         ``[(pending, reply), ...]`` for the transport to fan out."""
-        now = time.monotonic() if now is None else now
+        now = obs.monotonic() if now is None else now
         done: List[Tuple[PendingRequest, dict]] = []
         self._expire(now, done)
+        self._g_queue.set(len(self.queue))
         if not self.queue:
             return done
         work = list(self.queue)
@@ -497,10 +551,17 @@ class VerifierCore:
         for p in shrinks:
             job = p.packed
             d0 = job.counters["dispatches"]
+            t_s0 = obs.monotonic()
+            # first tick pins the queue wait; later ticks accumulate
+            # pure engine time into the device stage
+            p.stages.setdefault("queue_wait_ms",
+                                (t_s0 - p.t_in) * 1e3)
             try:
-                finished = job.step()
+                with obs.span("shrink.round", rid=p.rid):
+                    finished = job.step()
             except Exception as e:              # noqa: BLE001
                 self.m["engine_errors"] += 1
+                self._event("engine_error", obs.monotonic())
                 self._finish(p, self._reply(
                     p.rid, "unknown", kind="shrink",
                     cause=f"engine: {type(e).__name__}: {e}"), done)
@@ -511,6 +572,9 @@ class VerifierCore:
                 # models the tunnel round-trip each dispatch pays
                 time.sleep(self.inject_dispatch_latency_s
                            * (job.counters["dispatches"] - d0))
+            p.stages["device_ms"] = (
+                p.stages.get("device_ms", 0.0)
+                + (obs.monotonic() - t_s0) * 1e3)
             if finished:
                 self._finish(p, self._shrink_reply(p, job), done)
             else:
@@ -524,6 +588,18 @@ class VerifierCore:
         for p in self.queue:
             if p.t_dead is not None and now >= p.t_dead:
                 self.m["deadline_expired"] += 1
+                self._event("deadline", now)
+                # an expired check/txn request never reached a
+                # dispatch: its whole wait IS queue wait — exactly the
+                # tail the latency histogram must explain. A re-queued
+                # shrink job already pinned its real queue wait on the
+                # first tick (its later wall is engine rounds, already
+                # in device_ms) — observe the PINNED value, never the
+                # raw wall, or engine time pollutes the queue-wait p99
+                p.stages.setdefault("queue_wait_ms",
+                                    (now - p.t_in) * 1e3)
+                self._observe("queue_wait_ms",
+                              p.stages["queue_wait_ms"])
                 if p.kind == "shrink":
                     # deadline returns BEST-SO-FAR, flagged partial —
                     # a half-finished minimization is still a smaller
@@ -560,7 +636,11 @@ class VerifierCore:
         from ..models.memo import MemoOverflow
         from ..models.model import MODELS
 
-        t0 = time.monotonic()
+        t0 = obs.monotonic()
+        rids = [p.rid for p in items]
+        for p in items:
+            p.stages["queue_wait_ms"] = (t0 - p.t_in) * 1e3
+            self._observe("queue_wait_ms", p.stages["queue_wait_ms"])
         packeds = [p.packed for p in items]
         # the batch axis fills D shard slots per dispatch: pow2 AND a
         # multiple of the shard count, so every shard compiles the
@@ -570,16 +650,18 @@ class VerifierCore:
         packeds = packeds + [packeds[0]] * (b_prog - len(packeds))
         info: dict = {}
         try:
-            batch = pack_batch(packeds, MODELS[model_name](),
-                               n_pad=bucket.n_pad)
-            ns = _next_pow2(batch.memo.n_states)
-            nt = _next_pow2(batch.memo.n_transitions)
-            fin = check_batch_async(
-                batch, F=self.F, engine=self.engine, info=info,
-                mesh=self.mesh,
-                s_pad=bucket.S, k_pad=bucket.K,
-                n_states_pad=ns, n_transitions_pad=nt,
-                p_eff_pad=bucket.P_eff)
+            with obs.span("stage", kind="check", bucket=bucket.key,
+                          b=len(items), b_prog=b_prog, rids=rids):
+                batch = pack_batch(packeds, MODELS[model_name](),
+                                   n_pad=bucket.n_pad)
+                ns = _next_pow2(batch.memo.n_states)
+                nt = _next_pow2(batch.memo.n_transitions)
+                fin = check_batch_async(
+                    batch, F=self.F, engine=self.engine, info=info,
+                    mesh=self.mesh,
+                    s_pad=bucket.S, k_pad=bucket.K,
+                    n_states_pad=ns, n_transitions_pad=nt,
+                    p_eff_pad=bucket.P_eff)
         except MemoOverflow as e:
             cause = f"memo overflow: {e}"
             return lambda done: self._fail_batch(items, bucket, cause,
@@ -591,10 +673,14 @@ class VerifierCore:
             return lambda done: self._fail_batch(items, bucket, cause,
                                                  done)
 
-        t_staged = time.monotonic()
+        t_staged = obs.monotonic()
+        pack_ms = (t_staged - t0) * 1e3
+        self._observe("host_pack_ms", pack_ms)
+        for p in items:
+            p.stages["host_pack_ms"] = pack_ms
 
         def finish(done: list) -> None:
-            t_fin = time.monotonic()
+            t_fin = obs.monotonic()
             try:
                 status, fail_at, n_final = fin()
             except Exception as e:              # noqa: BLE001
@@ -603,7 +689,11 @@ class VerifierCore:
                 return
             if self.inject_dispatch_latency_s > 0.0:
                 time.sleep(self.inject_dispatch_latency_s)
+            t_done = obs.monotonic()
             eng = info.get("engine", self.engine)
+            xfer = info.get("transfer_bytes") or {}
+            self._account_dispatch(bucket.key, t_staged, t_done,
+                                   eng, xfer, rids)
             pk = (model_name, bucket.key, b_prog, ns, nt, self.F, eng)
             bs = self._bstats(bucket.key)
             bs.dispatches += 1
@@ -619,7 +709,7 @@ class VerifierCore:
             # under the tick loop's double buffer, wall time between
             # stage and finish belongs to the NEXT bucket's host pack
             # and must not inflate this bucket's device seconds
-            bs.device_s += (t_staged - t0) + (time.monotonic() - t_fin)
+            bs.device_s += (t_staged - t0) + (t_done - t_fin)
             if pk in self._programs:
                 self.m["program_hits"] += 1
             else:
@@ -628,18 +718,45 @@ class VerifierCore:
                 self.m["compiles"] += 1
             bs.programs.add(pk)
             self.m["dispatches"] += 1
-            for i, p in enumerate(items):
-                self._finish(p, self._reply(
-                    p.rid, protocol.verdict(status[i]),
-                    op_index=int(fail_at[i]),
-                    final_count=int(n_final[i]),
-                    engine=eng, bucket=bucket.key,
-                    batched=len(items)), done)
+            with obs.span("finalize", bucket=bucket.key, rids=rids):
+                for i, p in enumerate(items):
+                    p.stages["device_ms"] = (t_done - t_staged) * 1e3
+                    p.stages["finalize_ms"] = \
+                        (obs.monotonic() - t_done) * 1e3
+                    self._finish(p, self._reply(
+                        p.rid, protocol.verdict(status[i]),
+                        op_index=int(fail_at[i]),
+                        final_count=int(n_final[i]),
+                        engine=eng, bucket=bucket.key,
+                        batched=len(items)), done)
+            self._observe("finalize_ms",
+                          (obs.monotonic() - t_done) * 1e3)
 
         return finish
 
+    def _account_dispatch(self, bucket_key: str, t_staged: float,
+                          t_done: float, engine: str, xfer: dict,
+                          rids: list) -> None:
+        """Per-dispatch device window: the span (retroactive — the
+        device ran asynchronously since stage time), the device-stage
+        histogram, and the host<->device transfer-byte counters. The
+        device stage is dispatch->readback-complete: it includes the
+        async overlap window the double buffer creates plus any
+        injected tunnel latency, which is exactly what a request
+        WAITS on (the per-dispatch compute-only seconds stay in the
+        bucket's ``device_s``)."""
+        h2d, d2h = int(xfer.get("h2d", 0)), int(xfer.get("d2h", 0))
+        obs.record("device", t_staged, t_done, bucket=bucket_key,
+                   engine=engine, bytes_h2d=h2d, bytes_d2h=d2h,
+                   rids=rids)
+        self._observe("device_ms", (t_done - t_staged) * 1e3)
+        if not self._priming:
+            self._c_h2d.inc(h2d)
+            self._c_d2h.inc(d2h)
+
     def _fail_batch(self, items, bucket, cause, done) -> None:
         self.m["engine_errors"] += 1
+        self._event("engine_error", obs.monotonic())
         for p in items:
             self._finish(p, self._reply(p.rid, "unknown",
                                         cause=f"engine: {cause}",
@@ -659,15 +776,29 @@ class VerifierCore:
         from ..txn.closure_jax import closure_diag_batch
         from ..txn.counterexample import decode
 
-        t0 = time.monotonic()
-        adjs = [p.packed.padded(bucket.N) for p in items]
-        # same shard-slot fill as the check kind: D | b_prog, pow2
-        b_prog = max(_next_pow2(len(adjs)), self.shards)
-        adjs = adjs + [adjs[0]] * (b_prog - len(adjs))
+        t0 = obs.monotonic()
+        rids = [p.rid for p in items]
+        for p in items:
+            p.stages["queue_wait_ms"] = (t0 - p.t_in) * 1e3
+            self._observe("queue_wait_ms", p.stages["queue_wait_ms"])
+        with obs.span("stage", kind="txn", bucket=bucket.key,
+                      b=len(items), rids=rids):
+            adjs = [p.packed.padded(bucket.N) for p in items]
+            # same shard-slot fill as the check kind: D | b_prog, pow2
+            b_prog = max(_next_pow2(len(adjs)), self.shards)
+            adjs = adjs + [adjs[0]] * (b_prog - len(adjs))
+            stacked = np.stack(adjs)
+        t_staged = obs.monotonic()
+        pack_ms = (t_staged - t0) * 1e3
+        self._observe("host_pack_ms", pack_ms)
         try:
-            diag = closure_diag_batch(np.stack(adjs), mesh=self.mesh)
+            diag = closure_diag_batch(stacked, mesh=self.mesh)
+            # materialize HERE so the device stage times the actual
+            # dispatch+readback, not the first decode's lazy slice
+            diag = np.asarray(diag)
         except Exception as e:                  # noqa: BLE001
             self.m["engine_errors"] += 1
+            self._event("engine_error", obs.monotonic())
             for p in items:
                 self._finish(p, self._reply(
                     p.rid, "unknown", kind="txn",
@@ -676,6 +807,10 @@ class VerifierCore:
             return
         if self.inject_dispatch_latency_s > 0.0:
             time.sleep(self.inject_dispatch_latency_s)
+        t_done = obs.monotonic()
+        self._account_dispatch(
+            bucket.key, t_staged, t_done, "closure",
+            {"h2d": stacked.nbytes, "d2h": diag.nbytes}, rids)
         pk = ("txn", bucket.key, b_prog)
         bs = self._bstats(bucket.key)
         bs.dispatches += 1
@@ -687,7 +822,7 @@ class VerifierCore:
             fills = shard_fill(len(items), b_prog, self.shards)
             bs.shard_fill_sum += (
                 sum(1 for f in fills if f > 0) / self.shards)
-        bs.device_s += time.monotonic() - t0
+        bs.device_s += t_done - t0
         if pk in self._programs:
             self.m["program_hits"] += 1
         else:
@@ -696,12 +831,20 @@ class VerifierCore:
             self.m["compiles"] += 1
         bs.programs.add(pk)
         self.m["dispatches"] += 1
-        for i, p in enumerate(items):
-            g = p.packed
-            cex = decode(g, diag[i][:, :g.n], realtime=p.realtime)
-            self._finish(p, self._txn_reply(
-                p.rid, verdict_map(g, cex), engine="closure",
-                bucket=bucket.key, batched=len(items)), done)
+        with obs.span("finalize", kind="txn", bucket=bucket.key,
+                      rids=rids):
+            for i, p in enumerate(items):
+                g = p.packed
+                cex = decode(g, diag[i][:, :g.n],
+                             realtime=p.realtime)
+                p.stages["host_pack_ms"] = pack_ms
+                p.stages["device_ms"] = (t_done - t_staged) * 1e3
+                p.stages["finalize_ms"] = \
+                    (obs.monotonic() - t_done) * 1e3
+                self._finish(p, self._txn_reply(
+                    p.rid, verdict_map(g, cex), engine="closure",
+                    bucket=bucket.key, batched=len(items)), done)
+        self._observe("finalize_ms", (obs.monotonic() - t_done) * 1e3)
 
     def _host_check_txn(self, p: PendingRequest, done: list) -> None:
         """Over-limit txn graphs degrade to the host SCC engine, one
@@ -709,15 +852,18 @@ class VerifierCore:
         from ..txn import check_txn
 
         self.m["host_degraded"] += 1
+        t0 = self._degrade_begin(p)
         try:
-            result = check_txn((), graph=p.packed, backend="host",
-                               realtime=p.realtime)
+            with obs.span("host_degrade", kind="txn", rid=p.rid):
+                result = check_txn((), graph=p.packed, backend="host",
+                                   realtime=p.realtime)
             reply = self._txn_reply(p.rid, result, engine="host",
                                     degraded=True)
         except Exception as e:                  # noqa: BLE001
             reply = self._reply(p.rid, "unknown", kind="txn",
                                 cause=f"host engine: {e}",
                                 engine="host", degraded=True)
+        p.stages["device_ms"] = (obs.monotonic() - t0) * 1e3
         self._finish(p, reply, done)
 
     def _host_check(self, p: PendingRequest, done: list) -> None:
@@ -728,10 +874,12 @@ class VerifierCore:
         from ..models.model import MODELS
 
         self.m["host_degraded"] += 1
+        t0 = self._degrade_begin(p)
         try:
-            a = linear.analysis(MODELS[p.model](), p.packed,
-                                backend="host",
-                                max_host_configs=self.max_host_configs)
+            with obs.span("host_degrade", kind="check", rid=p.rid):
+                a = linear.analysis(
+                    MODELS[p.model](), p.packed, backend="host",
+                    max_host_configs=self.max_host_configs)
             reply = self._reply(
                 p.rid, a.valid,
                 op_index=(-1 if a.op_index is None else a.op_index),
@@ -740,7 +888,18 @@ class VerifierCore:
             reply = self._reply(p.rid, "unknown",
                                 cause=f"host engine: {e}",
                                 engine="host", degraded=True)
+        p.stages["device_ms"] = (obs.monotonic() - t0) * 1e3
         self._finish(p, reply, done)
+
+    def _degrade_begin(self, p: PendingRequest) -> float:
+        """Shared host-degrade stage bookkeeping: the engine run is
+        attributed to the device stage (it is what the request waits
+        on; the ``engine: "host"`` reply field disambiguates)."""
+        t0 = obs.monotonic()
+        p.stages["queue_wait_ms"] = (t0 - p.t_in) * 1e3
+        self._observe("queue_wait_ms", p.stages["queue_wait_ms"])
+        self._event("host_degraded", t0)
+        return t0
 
     # -- bookkeeping ---------------------------------------------------
 
@@ -752,11 +911,47 @@ class VerifierCore:
 
     def _finish(self, p: PendingRequest, reply: dict,
                 done: list) -> None:
-        lat_ms = (time.monotonic() - p.t_in) * 1e3
+        now = obs.monotonic()
+        lat_ms = (now - p.t_in) * 1e3
         reply.setdefault("latency_ms", round(lat_ms, 3))
+        # rounded ONCE, shared read-only by the reply, the timeline
+        # row and the trace record (single-threaded core)
+        stages = {k: round(v, 3) for k, v in p.stages.items()}
+        if stages:
+            reply.setdefault("stages", stages)
         self._latencies.append(lat_ms)
         self.m["completed"] += 1
+        if not self._priming:
+            self._h_latency.observe(lat_ms)
+            self._timeline.append({
+                "t": round(p.t_in - self.t_boot, 4),
+                "lat_ms": round(lat_ms, 3), "kind": p.kind,
+                "valid": reply.get("valid"), "stages": stages})
+        if obs.enabled():
+            # one complete per-request row for the trace: admission
+            # time to reply, rid-correlated, stage attribution in args
+            obs.record("request", p.t_in, now, rid=p.rid,
+                       kind=p.kind, valid=reply.get("valid"),
+                       **stages)
         done.append((p, reply))
+
+    def _observe(self, stage: str, ms: float) -> None:
+        """Feed one stage histogram sample (priming traffic never
+        pollutes the serving metrics)."""
+        if not self._priming:
+            self._stage_h[stage].observe(ms)
+
+    def _event(self, kind: str, now: Optional[float] = None) -> None:
+        if self._priming:
+            return
+        self._events.append({
+            "t": round((obs.monotonic() if now is None else now)
+                       - self.t_boot, 4),
+            "event": kind})
+
+    def timeline_records(self) -> Tuple[list, list]:
+        """(per-request rows, event marks) for the timeline SVG."""
+        return list(self._timeline), list(self._events)
 
     def _bstats(self, key: str) -> _BucketStats:
         bs = self._buckets.get(key)
@@ -779,21 +974,25 @@ class VerifierCore:
 
         n0 = self.m["dispatches"]
         sink: list = []
-        for n_events, copies in specs:
-            h = register_history(random.Random(seed), n_procs=3,
-                                 n_events=n_events, p_info=0.0)
-            packed = pack_history(h)
-            bucket = bucket_for(packed, self.limits)
-            if bucket is None:
-                continue
-            now = time.monotonic()
-            items = [PendingRequest(rid=None, model=self.model,
-                                    packed=packed, bucket=bucket,
-                                    t_in=now)
-                     for _ in range(max(1, copies))]
-            for i in range(0, len(items), self.batch_cap):
-                self._dispatch(self.model, bucket,
-                               items[i:i + self.batch_cap], sink)
+        self._priming = True       # priming must not pollute the
+        try:                       # serving histograms/timeline
+            for n_events, copies in specs:
+                h = register_history(random.Random(seed), n_procs=3,
+                                     n_events=n_events, p_info=0.0)
+                packed = pack_history(h)
+                bucket = bucket_for(packed, self.limits)
+                if bucket is None:
+                    continue
+                now = obs.monotonic()
+                items = [PendingRequest(rid=None, model=self.model,
+                                        packed=packed, bucket=bucket,
+                                        t_in=now)
+                         for _ in range(max(1, copies))]
+                for i in range(0, len(items), self.batch_cap):
+                    self._dispatch(self.model, bucket,
+                                   items[i:i + self.batch_cap], sink)
+        finally:
+            self._priming = False
         n = self.m["dispatches"] - n0
         self.m["primed"] += n
         # priming replies go nowhere: back their completion count and
@@ -806,8 +1005,55 @@ class VerifierCore:
 
     # -- observability -------------------------------------------------
 
+    def metrics_reply(self, rid=None) -> dict:
+        """The ``kind:"metrics"`` scrape reply: the JSON snapshot AND
+        the Prometheus text form in one frame (docs/service.md)."""
+        self._sync_metrics()
+        out = {"ok": True, "kind": "metrics",
+               "metrics": self.metrics.snapshot(),
+               "prometheus": self.metrics.render_prometheus()}
+        if rid is not None:
+            out["id"] = rid
+        return out
+
+    def _sync_metrics(self) -> None:
+        """Mirror the scalar state into the registry at scrape time:
+        the ``m`` counters, queue depth, per-bucket occupancy/
+        shard_fill, and the process-global compile counters
+        (``XLA_COMPILES`` / ``MOSAIC_BUILDS`` / ``closure_jax.
+        COMPILES`` — the compile-guard's units, so a scrape shows a
+        recompile storm as a moving counter)."""
+        m = self.metrics
+        self._g_queue.set(len(self.queue))
+        for k, v in self.m.items():
+            m.counter(f"service_{k}_total").value = v
+        for key, bs in self._buckets.items():
+            occ = (bs.occupancy_sum / bs.dispatches
+                   if bs.dispatches else 0.0)
+            m.gauge("service_bucket_occupancy",
+                    bucket=key).set(round(occ, 4))
+            m.gauge("service_bucket_requests", bucket=key) \
+                .set(bs.requests)
+            m.gauge("service_bucket_dispatches", bucket=key) \
+                .set(bs.dispatches)
+            if self.shards > 1:
+                fill = (bs.shard_fill_sum / bs.dispatches
+                        if bs.dispatches else 0.0)
+                m.gauge("service_bucket_shard_fill",
+                        bucket=key).set(round(fill, 4))
+        from ..checker import pallas_seg as PS
+        from ..txn import closure_jax as CJ
+        from ..utils import compile_guard as CG
+
+        m.counter("compile_xla_lowerings_total").value = \
+            CG.XLA_COMPILES
+        m.counter("compile_mosaic_builds_total").value = \
+            PS.MOSAIC_BUILDS
+        m.counter("compile_closure_programs_total").value = \
+            CJ.COMPILES
+
     def status(self, now: Optional[float] = None) -> dict:
-        now = time.monotonic() if now is None else now
+        now = obs.monotonic() if now is None else now
         lats = sorted(self._latencies)
         buckets = {}
         for key, bs in self._buckets.items():
@@ -847,8 +1093,22 @@ class VerifierCore:
                 "p99": round(_percentile(lats, 0.99), 3),
                 "n": len(lats),
             },
+            # the stage-histogram quantiles ride the status artifact
+            # (harness.store web status) so the p99/p50 gap is
+            # attributable without a full metrics scrape
+            "stage_ms": {
+                s.replace("_ms", ""): {
+                    "p50": round(h.quantile(0.50), 3),
+                    "p95": round(h.quantile(0.95), 3),
+                    "p99": round(h.quantile(0.99), 3),
+                    "n": h.count,
+                } for s, h in self._stage_h.items()},
+            "transfer_bytes": {"h2d": self._c_h2d.value,
+                               "d2h": self._c_d2h.value},
+            "tracing": obs.enabled(),
             "buckets": buckets,
         }
 
 
-__all__ = ["DEFAULT_PRIME", "PendingRequest", "VerifierCore"]
+__all__ = ["DEFAULT_PRIME", "PendingRequest", "STAGES",
+           "VerifierCore"]

@@ -13,21 +13,26 @@ Discovery: with ``--pmux``, the daemon publishes its port under
 ``sut/verifier`` through the same ``ct_pmux`` path the native SUT
 uses (``control/pmux.py``); clients then resolve the service by name.
 
-Observability: ``{"op": "status"}`` returns the metrics JSON on the
-same socket; with ``--store`` the same snapshot is persisted through
-:func:`comdb2_tpu.harness.store.save_service_status` on every
-artifact interval and at shutdown, where the store web browser serves
-it next to test runs.
+Observability: ``{"op": "status"}`` returns the status JSON on the
+same socket and ``{"op": "metrics"}`` (or ``kind:"metrics"`` on the
+check op) scrapes the metrics plane (Prometheus text + JSON forms —
+docs/observability.md); with ``--store`` the status snapshot is
+persisted through :func:`comdb2_tpu.harness.store.
+save_service_status` on every artifact interval and at shutdown,
+alongside ``timeline.svg`` (the per-run latency/rate timeline) and —
+with ``--trace`` — ``trace.json`` (Chrome/Perfetto span export),
+where the store web browser serves them next to test runs.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import selectors
 import socket
-import time
 from typing import Dict, Optional
 
+from ..obs import trace as obs
 from . import protocol
 from .core import VerifierCore
 
@@ -80,12 +85,12 @@ class VerifierDaemon:
 
     def run(self) -> None:
         self._pmux_publish()
-        last_artifact = time.monotonic()
+        last_artifact = obs.monotonic()
         try:
             while not self._stop:
                 timeout = self._select_timeout()
                 got_bytes = self._pump(timeout)
-                now = time.monotonic()
+                now = obs.monotonic()
                 if self._should_tick(now, got_bytes):
                     for p, reply in self.core.tick(now):
                         self._send(p.ctx, reply)
@@ -106,7 +111,7 @@ class VerifierDaemon:
         if self.core.queue:
             oldest = self.core.queue[0].t_in
             remaining = max(0.0, oldest + self.coalesce_s
-                            - time.monotonic())
+                            - obs.monotonic())
             return min(remaining, self.IDLE_PROBE_S)
         return 0.5
 
@@ -206,7 +211,7 @@ class VerifierDaemon:
                 protocol.BAD_REQUEST, str(e)))
             return
         op = req.get("op")
-        now = time.monotonic()
+        now = obs.monotonic()
         if op == "check":
             try:
                 pending, reply = self.core.submit(req, now, ctx=conn)
@@ -228,6 +233,9 @@ class VerifierDaemon:
             if rid is not None:
                 out["id"] = rid
             self._send(conn, out)
+        elif op == "metrics":
+            # alias of kind:"metrics" — same reply, scrape-friendly
+            self._send(conn, self.core.metrics_reply(rid))
         elif op == "ping":
             self._send(conn, {"ok": True, "pong": True,
                               **({"id": rid} if rid is not None
@@ -276,12 +284,35 @@ class VerifierDaemon:
                                 store_root=self.store_root)
         except OSError as e:
             logger.warning("service artifact write failed: %s", e)
+        self._save_obs()
+
+    def _save_obs(self) -> None:
+        """The observability artifacts next to the status snapshot:
+        ``trace.json`` (Chrome/Perfetto trace-event export — only
+        when tracing is enabled) and ``timeline.svg`` (the per-run
+        latency/rate timeline), both under ``<store>/service/`` where
+        the store web index links them."""
+        d = os.path.join(self.store_root, "service")
+        try:
+            os.makedirs(d, exist_ok=True)
+            if obs.enabled():
+                obs.export_chrome(os.path.join(d, "trace.json"))
+            records, events = self.core.timeline_records()
+            if records:
+                from ..report.service_svg import \
+                    render_service_timeline
+
+                render_service_timeline(
+                    records, events,
+                    path=os.path.join(d, "timeline.svg"))
+        except OSError as e:
+            logger.warning("obs artifact write failed: %s", e)
 
     def _shutdown(self) -> None:
         """Answer nothing new, flush queued requests as unknown, close
         every socket — a clean exit, never a hang with clients blocked
         on reads."""
-        for p, reply in self.core.tick(time.monotonic()):
+        for p, reply in self.core.tick(obs.monotonic()):
             self._send(p.ctx, reply)
         for conn in list(self._conns.values()):
             self._close(conn)

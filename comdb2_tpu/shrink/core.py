@@ -32,13 +32,13 @@ history has nothing to preserve.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
 from ..checker import linear_jax as LJ
+from ..obs import trace as _obs
 from ..models.memo import memoize_model, transitions_of
 from ..models.model import MODELS, Model
 from ..ops.op import INFO, INVOKE, Op
@@ -177,12 +177,14 @@ class DdminEngine:
 
     def step(self) -> bool:
         """Run one round; True when minimization is finished."""
-        if self.phase == "seed":
-            self._seed_round()
-        elif self.phase == "ddmin":
-            self._ddmin_round()
-        elif self.phase == "greedy":
-            self._greedy_round()
+        with _obs.span("shrink.step", phase=self.phase,
+                       rounds=self.counters.get("rounds", 0)):
+            if self.phase == "seed":
+                self._seed_round()
+            elif self.phase == "ddmin":
+                self._ddmin_round()
+            elif self.phase == "greedy":
+                self._greedy_round()
         return self.phase == "done"
 
     def _ddmin_round(self) -> None:
@@ -372,10 +374,10 @@ def minimize(history, *, checker: str = "linear",
                        max_states=max_states)
     else:
         raise ValueError(f"no shrinker for checker {checker!r}")
-    t0 = time.monotonic()
+    t0 = _obs.monotonic()
     while not job.step():
         if deadline_s is not None \
-                and time.monotonic() - t0 >= deadline_s:
+                and _obs.monotonic() - t0 >= deadline_s:
             return job.result(partial=True)
         if job.rounds >= max_rounds:
             return job.result(partial=True)

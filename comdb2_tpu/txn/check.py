@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..obs import trace as _obs
 from ..ops.op import Op
 from .edges import TxnGraph, infer_edges
 
@@ -28,6 +29,7 @@ from .edges import TxnGraph, infer_edges
 DEVICE_THRESHOLD = 1024
 
 
+@_obs.traced("txn.check")
 def check_txn(history: Sequence[Op],
               backend: str = "auto",
               realtime: bool = False,

@@ -18,7 +18,13 @@ Also asserts the serving guarantees that are backend-independent:
 - coalesced dispatch count per bucket <= ceil(requests / batch cap);
 - the daemon survives a client disconnect mid-request;
 - an over-capacity burst gets explicit ``overload`` replies, not
-  hangs.
+  hangs;
+- every reply's per-stage breakdown (queue-wait / host-pack / device /
+  finalize) sums to within 10% of its measured wall, the scrape's
+  per-stage histograms are populated (``stages_ms`` in the JSON), and
+  the daemon's shutdown trace artifact (``--trace --store``) is a
+  non-empty Perfetto-loadable span export with request-id correlation
+  and transfer-byte attribution (docs/observability.md).
 
 The throughput ratio is asserted against ``--min-speedup`` (default
 5.0, the acceptance bar). The ratio is a per-dispatch-overhead
@@ -51,6 +57,7 @@ import random
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -126,7 +133,60 @@ def run_coalesced(port, payloads):
         s.close()
     for r in replies:
         assert r["ok"], r
-    return dt
+    return dt, replies
+
+
+def assert_stages_tile_wall(replies):
+    """Per request: the stage breakdown (queue-wait / host-pack /
+    device / finalize) must sum to within 10% of the measured wall —
+    the attribution contract that makes the histograms trustworthy.
+    A small absolute floor absorbs scheduler jitter on quick CPU runs
+    where total latency is single-digit ms."""
+    checked = 0
+    for r in replies:
+        stages = r.get("stages")
+        if not stages:
+            continue
+        total = sum(stages.values())
+        lat = r["latency_ms"]
+        tol = max(0.1 * lat, 5.0)
+        assert abs(total - lat) <= tol, (
+            f"stage sum {total:.3f} ms vs wall {lat:.3f} ms "
+            f"(> {tol:.3f} ms apart): {stages}")
+        checked += 1
+    assert checked, "no reply carried a stage breakdown"
+    return checked
+
+
+def stage_quantiles(metrics_snapshot):
+    """{stage: {p50,p95,p99,count}} from a kind:"metrics" scrape."""
+    out = {}
+    for stage in ("queue_wait", "host_pack", "device", "finalize"):
+        series = metrics_snapshot[f"service_{stage}_ms"]["series"][0]
+        out[stage] = {k: series[k]
+                      for k in ("p50", "p95", "p99", "count")}
+    return out
+
+
+def load_trace(store_dir):
+    """The daemon's Perfetto export (written at shutdown): must load,
+    be non-empty, and carry the correlated span pipeline — admission
+    through per-request rows plus device spans with transfer-byte
+    attribution."""
+    path = os.path.join(store_dir, "service", "trace.json")
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc["traceEvents"]
+    assert events, "trace artifact is empty"
+    names = {e["name"] for e in events}
+    assert {"admission", "stage", "device", "finalize",
+            "request"} <= names, names
+    dev = [e for e in events if e["name"] == "device"]
+    assert any(e["args"].get("bytes_h2d", 0) > 0 for e in dev), \
+        "no device span carries transfer-byte attribution"
+    assert any("rid" in e["args"] for e in events), \
+        "no span is request-id correlated"
+    return path, len(events)
 
 
 def request_one(port, obj):
@@ -208,7 +268,21 @@ def main() -> int:
                          "(what the test suite uses)")
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "BENCH_service.json"))
+    ap.add_argument("--store-dir", default=None, metavar="DIR",
+                    help="store root the daemon writes its obs "
+                         "artifacts into (trace.json/timeline.svg; "
+                         "default: ./store, a tmpdir under --quick)")
     args = ap.parse_args()
+    if args.store_dir is None:
+        args.store_dir = (tempfile.mkdtemp(prefix="bench_service_")
+                          if args.quick
+                          else os.path.join(REPO, "store"))
+    # a persistent store dir may hold a PREVIOUS run's trace: delete
+    # it up front so load_trace can only ever validate THIS run's
+    # artifact (the daemon's artifact-write failures are log-only)
+    stale = os.path.join(args.store_dir, "service", "trace.json")
+    if os.path.exists(stale):
+        os.unlink(stale)
     if args.tunnel_ms is None:
         args.tunnel_ms = 100.0 if args.backend == "cpu" else 0.0
     if args.quick:
@@ -225,7 +299,13 @@ def main() -> int:
                                str(max(256, 2 * args.requests)),
                                "--coalesce-ms", "25",
                                "--inject-dispatch-latency-ms",
-                               str(args.tunnel_ms)))
+                               str(args.tunnel_ms),
+                               # the obs plane rides the benched run:
+                               # the trace artifact lands in the
+                               # store dir at shutdown, and the <2%
+                               # budget means tracing on does not
+                               # move the headline numbers
+                               "--trace", "--store", args.store_dir))
     try:
         # warm BOTH program classes fully (every bucket's B=1 serial
         # program and every pow2-B coalesced program) so the timed
@@ -237,8 +317,17 @@ def main() -> int:
         st0 = status(port)
         serial_s = run_serial(port, payloads)
         st1 = status(port)
-        coalesced_s = run_coalesced(port, payloads)
+        coalesced_s, co_replies = run_coalesced(port, payloads)
         st2 = status(port)
+        # the per-stage attribution contract, per request, from the
+        # timed coalesced phase's own replies
+        stage_checked = assert_stages_tile_wall(co_replies)
+        scrape = request_one(port, {"op": "metrics"})
+        assert scrape["ok"] and scrape["kind"] == "metrics", scrape
+        stages = stage_quantiles(scrape["metrics"])
+        assert stages["queue_wait"]["count"] > 0, stages
+        assert stages["device"]["count"] > 0, stages
+        assert "service_queue_wait_ms_bucket" in scrape["prometheus"]
 
         n = args.requests
         serial_tp = n / serial_s
@@ -267,6 +356,7 @@ def main() -> int:
     finally:
         stop_daemon(proc, port)
 
+    trace_path, trace_events = load_trace(args.store_dir)
     overloads = check_overload_burst(args.backend, texts[0])
 
     out = {
@@ -284,6 +374,9 @@ def main() -> int:
         "coalesced_dispatches_per_bucket": co_disp,
         "requests_per_bucket": co_req,
         "latency_ms": lat,
+        "stages_ms": stages,
+        "stage_sum_checked": stage_checked,
+        "trace": {"path": trace_path, "events": trace_events},
         "overload_replies": overloads,
         "survived_disconnect": survived,
         "programs_after_warm": st0["programs"],

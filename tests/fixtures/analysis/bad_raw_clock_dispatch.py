@@ -1,0 +1,18 @@
+"""Seeded violation: raw clock read inside a dispatch-pipeline module.
+
+``time.monotonic()``/``time.time()`` taken directly around a device
+dispatch — timing must go through ``comdb2_tpu.obs.trace``
+(``monotonic()``, the span API) so queue-wait/device attribution
+stays on one clock (rule ``raw-clock-in-pipeline``; the "dispatch"
+basename puts this file in the rule's scope, like the production
+service/shrink/txn modules)."""
+
+import time
+from time import perf_counter
+
+
+def dispatch_with_raw_clock(engine, batch):
+    t0 = time.monotonic()              # finding: raw monotonic
+    result = engine.dispatch(batch)
+    wall = time.time() - t0            # finding: raw wall clock
+    return result, wall, perf_counter()  # finding: from-import form

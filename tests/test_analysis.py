@@ -45,6 +45,7 @@ FIXTURE_RULES = {
     "bad_unsharded_mesh_dispatch.py": "unbucketed-dispatch-site",
     "bad_vmap_sharded_route.py": "vmap-sharded-oracle",
     "bad_stale_suppression.py": "stale-suppression",
+    "bad_raw_clock_dispatch.py": "raw-clock-in-pipeline",
 }
 
 

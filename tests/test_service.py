@@ -288,6 +288,13 @@ def test_bench_service_quick():
         assert res["coalesced_dispatches"] <= res["requests"]
         assert res["overload_replies"] >= 1
         assert res["survived_disconnect"] is True
+        # the obs plane rides the bench: per-stage histograms from
+        # the scrape, per-reply stage sums checked, trace artifact
+        assert set(res["stages_ms"]) == {"queue_wait", "host_pack",
+                                         "device", "finalize"}
+        assert res["stages_ms"]["device"]["count"] > 0
+        assert res["stage_sum_checked"] >= 1
+        assert res["trace"]["events"] > 0
     finally:
         if os.path.exists(out):
             os.unlink(out)
