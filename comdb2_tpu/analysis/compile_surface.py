@@ -271,6 +271,7 @@ def static_inventory() -> Inventory:
     mesh_D = Axis("D", "pow2", *L["mesh_D"])
     run_templates = []
     run_sharded_templates = []
+    reset_templates = []
     for W in L["kernel_words"]:
         run_templates.append(
             ((n_chunks, chunk, width),)
@@ -285,6 +286,12 @@ def static_inventory() -> Inventory:
             + ((mesh_D, rows, lane),) * W
             + ((mesh_D, one, lane), (mesh_D, b_pad, lane),
                (table_rows, lane), ()))
+        # the donated-carry reset (pallas_seg._reset_fn): re-fills a
+        # recycled (ws, stat) carry set on device — inputs are the
+        # scan's carry shapes, one program per (spec word/row class,
+        # b_pad) already admitted by the run templates above
+        reset_templates.append(
+            ((rows, lane),) * W + ((one, lane),))
 
     N = Axis("N", "pow2", *L["txn_N"])
     N8 = Axis("N/8", "pow2", L["txn_N"][0] // 8, L["txn_N"][1] // 8)
@@ -293,7 +300,7 @@ def static_inventory() -> Inventory:
     sites = (
         Site(
             key="pallas-stream-scan",
-            jit_names=("run", "run_sharded"),
+            jit_names=("run", "run_sharded", "carry_reset"),
             note="fused-kernel chunk scan (checker/pallas_seg._scan_fn)"
                  ": one Mosaic program per (SegKernelSpec, b_pad, "
                  "stream); specs are drawn from the production tier "
@@ -303,9 +310,14 @@ def static_inventory() -> Inventory:
                  "`run_sharded` (pallas_seg._sharded_scan_fn) is the "
                  "shard_map form: the SAME per-shard kernel body with "
                  "a leading mesh axis D on every per-shard tensor — "
-                 "per-shard shapes are the global shapes divided by D",
+                 "per-shard shapes are the global shapes divided by D. "
+                 "`carry_reset` (pallas_seg._reset_fn) is the "
+                 "donated-carry recycle program: constants into a "
+                 "donated (ws, stat) carry set, one per (spec, b_pad) "
+                 "the run ladder already admits",
             templates=tuple(run_templates)
-            + tuple(run_sharded_templates),
+            + tuple(run_sharded_templates)
+            + tuple(reset_templates),
             axes_doc=(chunk, width, rows, table_rows, b_pad, mesh_D,
                       Axis("n_words", "enum",
                            values=L["kernel_words"]), n_chunks),
@@ -397,6 +409,17 @@ def _witness_specs():
             st((1, 128)), st((8, 128)),
             st((spec.table_rows_pad, 128)), 32)
 
+    def carry_reset_witness():
+        from ..checker import pallas_seg as PS
+
+        spec = PS.spec_for(8, 32, 4, 2)
+        assert spec is not None
+        reset = PS._reset_fn(spec, 8)
+        W = spec.n_words
+        return jax.eval_shape(
+            reset, tuple(st((spec.rows, 128)) for _ in range(W)),
+            st((1, 128)))
+
     def keys_witness():
         from ..checker import linear_jax as LJ
 
@@ -460,6 +483,9 @@ def _witness_specs():
         ("pallas-stream-scan",
          "run_sharded: same spec, D=1 mesh rung",
          kernel_sharded_witness),
+        ("pallas-stream-scan",
+         "carry_reset: same spec carry shapes, b_pad=8",
+         carry_reset_witness),
         ("xla-batch-engines",
          "check_device_keys at (ns,nt)=(16,16) S=8 B=4 K=2",
          keys_witness),

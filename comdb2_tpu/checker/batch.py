@@ -504,18 +504,24 @@ def _stream_collect(pending, B):
     per-slice verdicts (each ``np.asarray`` waits on that slice's
     device only). ``B`` is the PADDED batch width when the slices were
     sharded — the caller slices sentinel verdicts off before anything
-    user-visible."""
+    user-visible. A readback failure clears the donated-carry pool:
+    the failed scan's carries were recycled at dispatch time and must
+    not seed the next same-shape dispatch."""
     rs: list = [None] * B
-    for handle, start, end in pending:
-        if len(handle) == 3:          # sharded: (res, starts, D)
-            res, starts, D = handle
-            out = PSEG.merge_stream_shards(np.asarray(res), starts,
-                                           end - start, D)
-        else:
-            res, starts = handle
-            out = PSEG.merge_stream_slice(np.asarray(res), starts,
-                                          end - start)
-        rs[start:end] = out
+    try:
+        for handle, start, end in pending:
+            if len(handle) == 3:      # sharded: (res, starts, D)
+                res, starts, D = handle
+                out = PSEG.merge_stream_shards(np.asarray(res),
+                                               starts, end - start, D)
+            else:
+                res, starts = handle
+                out = PSEG.merge_stream_slice(np.asarray(res), starts,
+                                              end - start)
+            rs[start:end] = out
+    except Exception:
+        PSEG.clear_carry_pool()
+        raise
     return rs
 
 

@@ -110,7 +110,45 @@ def use_interpret(on: bool = True) -> None:
     _chunk_jit.cache_clear()
     _scan_fn.cache_clear()
     _sharded_scan_fn.cache_clear()
+    _reset_fn.cache_clear()
+    _CARRY_POOL.clear()
     available.cache_clear()
+
+
+# Carry donation (continuous-batching round): the streamed scan's
+# carry buffers (frontier words, stat row, results block) are marked
+# donate_argnums so XLA aliases them into the scan outputs instead of
+# allocating a second copy, and finished carries are RECYCLED through
+# a per-(spec, b_pad, device) pool — the next dispatch of a hot bucket
+# re-initializes the previous dispatch's device buffers with a tiny
+# on-device ``carry_reset`` program instead of re-uploading initial
+# values over the ~25 MB/s tunnel. Gated (env or use_carry_donation)
+# so the donated and non-donated paths can be bit-compared.
+_DONATE = _os.environ.get("COMDB2_TPU_DONATE_CARRIES", "1") != "0"
+
+#: carries re-initialized on device instead of re-uploaded — the
+#: serving metrics mirror this next to MOSAIC_BUILDS
+CARRY_REUSES = 0
+
+#: recycled (ws_tuple, stat) carry sets per (spec, b_pad, device) —
+#: bounded per key; entries are device arrays from finished dispatches
+_CARRY_POOL: dict = {}
+_CARRY_POOL_CAP = 4
+
+
+def donation_active() -> bool:
+    return _DONATE
+
+
+def use_carry_donation(on: bool = True) -> None:
+    """Toggle carry donation + pooling (the parity tests compare the
+    two paths bit-for-bit). Disabling drops the pooled device buffers;
+    the jitted scan variants are cached per flag, so no recompiles."""
+    global _DONATE
+    if _DONATE == on:
+        return
+    _DONATE = on
+    _CARRY_POOL.clear()
 
 
 class SegKernelSpec(NamedTuple):
@@ -800,18 +838,21 @@ def pack_segments(segs, spec: SegKernelSpec) -> np.ndarray:
 
 @functools.lru_cache(maxsize=32)
 def _scan_fn(spec: SegKernelSpec, b_pad: int = 8,
-             stream: bool = False):
+             stream: bool = False, donate: bool = False):
     """Jitted scan over chunk calls. ``stream=False`` short-circuits
     dead chunks once the (single) history failed; stream mode always
     runs every chunk (later histories are still live) and threads the
-    per-history results buffer through the scan."""
+    per-history results buffer through the scan. ``donate`` marks the
+    carry buffers (ws0/stat0/res0) donated — XLA aliases them into the
+    scan outputs instead of holding both copies live; callers must not
+    reuse the donated input arrays (``stream_dispatch`` builds or
+    recycles them fresh per call)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     call = _chunk_call(spec, b_pad)
 
-    @jax.jit
     def run(seg_chunks, ws0, stat0, res0, table, stride):
         n_chunks = seg_chunks.shape[0]
 
@@ -837,7 +878,9 @@ def _scan_fn(spec: SegKernelSpec, b_pad: int = 8,
             step, (tuple(ws0), stat0, res0), (seg_chunks, offs))
         return ws, stat, res
 
-    return run
+    if donate:
+        return jax.jit(run, donate_argnums=(1, 2, 3))
+    return jax.jit(run)
 
 
 def check_device_pallas(succ: np.ndarray, segs, *, n_states: int,
@@ -948,9 +991,13 @@ def check_device_pallas_stream(succ: np.ndarray, segs_list, *,
                                        spec, n_states, n_transitions,
                                        dev))
     out = []
-    for (res, starts), (start, end, _) in zip(pending, plan):
-        res = np.asarray(res)       # blocks on THIS slice's device only
-        out.extend(merge_stream_slice(res, starts, end - start))
+    try:
+        for (res, starts), (start, end, _) in zip(pending, plan):
+            res = np.asarray(res)   # blocks on THIS slice's device only
+            out.extend(merge_stream_slice(res, starts, end - start))
+    except Exception:
+        clear_carry_pool()          # recycled-at-dispatch carries of a
+        raise                       # failed scan must not be reused
     return out
 
 
@@ -1013,36 +1060,95 @@ def merge_stream_shards(res: np.ndarray, starts, n: int, D: int):
     return out
 
 
+@functools.lru_cache(maxsize=32)
+def _reset_fn(spec: SegKernelSpec, b_pad: int):
+    """On-device carry re-initialization for the recycle pool: takes a
+    finished dispatch's (ws, stat) device buffers DONATED, returns
+    them re-filled with the initial frontier/stat constants plus a
+    fresh zero results block — pure device compute, so a hot bucket's
+    next dispatch ships no initial-carry bytes over the tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    ws_init = tuple(np.asarray(w) for w in initial_frontier(spec))
+    stat_init = _init_stat()
+
+    def carry_reset(ws, stat):
+        del ws, stat            # donated: only their buffers survive
+        return (tuple(jnp.asarray(w) for w in ws_init),
+                jnp.asarray(stat_init),
+                jnp.zeros((b_pad, LANES), jnp.int32))
+
+    return jax.jit(carry_reset, donate_argnums=(0, 1))
+
+
+def _carry_recycle(key, ws, stat) -> None:
+    """Return a finished dispatch's carry buffers to the pool (bounded
+    per key; the results block is NOT pooled — the caller still owns
+    its readback)."""
+    pool = _CARRY_POOL.setdefault(key, [])
+    if len(pool) < _CARRY_POOL_CAP:
+        pool.append((ws, stat))
+
+
+def clear_carry_pool() -> None:
+    """Drop every pooled carry. Recycling happens at DISPATCH time
+    (JAX is async — a device-side failure only surfaces at the
+    caller's readback), so a failed dispatch's carries are already
+    pooled when the error arrives; the readback sites call this on
+    failure, or the poisoned buffers would re-enter every following
+    same-key dispatch until restart."""
+    _CARRY_POOL.clear()
+
+
 def stream_dispatch(succ, segs_list, spec, n_states, n_transitions,
                     device=None):
     """Dispatch one streamed kernel call asynchronously (optionally
     pinned to ``device``); returns (res_device_array, starts). The
     caller owns the readback (``np.asarray(res)``) — the pipelined
     batch path (``checker.batch``) packs/stages the NEXT slice on the
-    host while this one runs on the device."""
+    host while this one runs on the device.
+
+    With carry donation on (:func:`use_carry_donation`, the default)
+    the frontier/stat/results carries are donated into the scan and
+    the finished (ws, stat) buffers are recycled through the carry
+    pool: a hot bucket's next dispatch resets them ON DEVICE
+    (:func:`_reset_fn`) instead of re-uploading initial values —
+    ``CARRY_REUSES`` counts the hits."""
     import jax
     import jax.numpy as jnp
 
-    global DISPATCHES
+    global DISPATCHES, CARRY_REUSES
     B = len(segs_list)
     b_pad = 8                 # pow2 buckets bound kernel recompiles
     while b_pad < B:
         b_pad *= 2
     chunks, starts = pack_stream(segs_list, spec)
-    ws0 = initial_frontier(spec)
     table = pack_table(succ[:n_states, :n_transitions],
                        spec.table_rows_pad)
-    args = [chunks] + ws0 + [_init_stat(),
-                             np.zeros((b_pad, LANES), np.int32), table]
-    if device is not None:
-        args = [jax.device_put(a, device) for a in args]
+
+    def put(a):
+        return (jax.device_put(a, device) if device is not None
+                else jnp.asarray(a))
+
+    key = (spec, b_pad, device)
+    pool = _CARRY_POOL.get(key) if _DONATE else None
+    if pool:
+        ws_t, stat0, res0 = _reset_fn(spec, b_pad)(*pool.pop())
+        CARRY_REUSES += 1
     else:
-        args = [jnp.asarray(a) for a in args]
-    W = spec.n_words
-    run = _scan_fn(spec, b_pad=b_pad, stream=True)
-    _, _, res = run(args[0], tuple(args[1:1 + W]), *args[1 + W:],
-                    n_transitions)
+        ws_t = tuple(put(w) for w in initial_frontier(spec))
+        stat0 = put(_init_stat())
+        res0 = put(np.zeros((b_pad, LANES), np.int32))
+    run = _scan_fn(spec, b_pad=b_pad, stream=True, donate=_DONATE)
+    ws, stat, res = run(put(chunks), ws_t, stat0, res0, put(table),
+                        n_transitions)
     DISPATCHES += 1
+    if _DONATE:
+        # ws/stat are never read back by stream callers — recycle them
+        # for the next same-shape dispatch (res joins the pool only
+        # implicitly, via the allocator, after the caller's readback)
+        _carry_recycle(key, ws, stat)
     return res, starts
 
 

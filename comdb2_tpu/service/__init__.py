@@ -10,12 +10,14 @@ daemon:
 - :mod:`.protocol`   — newline-JSON framing over TCP.
 - :mod:`.bucketing`  — shape quantization: a small closed set of
   compiled programs no matter what traffic arrives.
-- :mod:`.core`       — admission queue, coalescing dispatcher,
+- :mod:`.core`       — continuous-batching admission (slot-filling
+  launches, the bounded in-flight ring, donated carries),
   backpressure/deadlines, host-engine degradation, metrics.
-- :mod:`.daemon`     — the selector loop; ``python -m
+- :mod:`.daemon`     — the selector/pump loop; ``python -m
   comdb2_tpu.service`` runs it (pmux discovery, store artifacts).
-- :mod:`.client`     — retrying client; ``filetest --service`` uses
-  it.
+- :mod:`.client`     — retrying client with overload backoff, plus
+  the consistent-hash :class:`~.client.RoutedClient` over a
+  pmux-discovered fleet; ``filetest --service`` uses the former.
 - :mod:`.sharding`   — device meshes + sharded batch checking (the
   former ``comdb2_tpu.parallel``).
 """

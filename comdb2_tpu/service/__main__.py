@@ -70,10 +70,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "1 = single-device path, no mesh)")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission cap; beyond it requests get "
-                        "explicit overload replies")
-    p.add_argument("--coalesce-ms", type=float, default=5.0,
-                   help="how long a queued request may wait for "
-                        "batch-mates before a tick fires")
+                        "explicit overload replies (with a "
+                        "retry_after_ms hint)")
+    p.add_argument("--fill-ms", "--coalesce-ms", type=float,
+                   default=5.0, dest="fill_ms",
+                   help="cap on how long a forming batch may wait "
+                        "for batch-mates (continuous batching: a "
+                        "full batch launches immediately, an idle "
+                        "wire launches everything; deadlines tighten "
+                        "this per request). --coalesce-ms is the "
+                        "legacy spelling")
+    p.add_argument("--ring", type=int, default=3, metavar="N",
+                   help="bounded in-flight dispatch ring depth: N "
+                        "buckets staged/running/finalizing "
+                        "concurrently (host-pack vs async device "
+                        "overlap)")
+    p.add_argument("--no-donate", action="store_true",
+                   help="disable carry-buffer donation + the device "
+                        "carry pool (parity/debugging; donation is "
+                        "the production default)")
     p.add_argument("--max-ops", type=int, default=8192)
     p.add_argument("--max-segments", type=int, default=4096)
     p.add_argument("--no-prime", action="store_true",
@@ -93,6 +108,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="publish the port under sut/verifier via "
                         "ct_pmux at PORT (default 5105)")
     p.add_argument("--pmux-service", default=PMUX_SERVICE)
+    p.add_argument("--pmux-shard", type=int, default=None,
+                   metavar="IDX",
+                   help="register as sut/verifier/IDX — one entry "
+                        "per daemon of a horizontally scaled fleet; "
+                        "RoutedClient consistent-hash routes over "
+                        "all of them")
     p.add_argument("--store", default=None, metavar="DIR",
                    help="persist status snapshots under DIR/service/ "
                         "(served by the store web browser)")
@@ -113,6 +134,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..checker import pallas_seg
 
         pallas_seg.use_interpret(True)
+    if args.no_donate:
+        from ..checker import pallas_seg
+
+        pallas_seg.use_carry_donation(False)
     limits = ServiceLimits(max_ops=args.max_ops,
                            max_segments=args.max_segments)
     core = VerifierCore(
@@ -120,20 +145,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         F=args.frontier, batch_cap=args.batch_cap,
         max_queue=args.max_queue, limits=limits,
         inject_dispatch_latency_s=args.inject_dispatch_latency_ms
-        / 1e3, shards=args.shards)
+        / 1e3, shards=args.shards,
+        fill_window_s=args.fill_ms / 1e3, ring_depth=args.ring)
+    pmux_service = args.pmux_service
+    if args.pmux_shard is not None:
+        pmux_service = f"{PMUX_SERVICE}/{args.pmux_shard}"
     daemon = VerifierDaemon(core, host=args.host, port=args.port,
-                            coalesce_s=args.coalesce_ms / 1e3,
                             pmux_port=args.pmux,
-                            pmux_service=args.pmux_service,
+                            pmux_service=pmux_service,
                             store_root=args.store)
     signal.signal(signal.SIGTERM, daemon.stop)
     signal.signal(signal.SIGINT, daemon.stop)
     primed = 0
     if not args.no_prime:
         primed = core.prime(DEFAULT_PRIME)
+    # publish BEFORE the ready line: "ready" must mean discoverable.
+    # Publish failure keeps the daemon serving (discovery is
+    # additive) but the ready line then reports pmux_service null —
+    # a fleet booter gating on it sees the truth instead of racing
+    # RoutedClient.discover against a registration that never
+    # happened.
+    daemon._pmux_publish()
     print(json.dumps({"ready": True, "host": daemon.host,
                       "port": daemon.port, "backend": backend,
                       "model": args.model, "shards": args.shards,
+                      "ring": args.ring,
+                      "fill_ms": args.fill_ms,
+                      "pmux_service": (pmux_service
+                                       if daemon.published
+                                       else None),
                       "primed": primed, "trace": args.trace}),
           flush=True)
     daemon.run()

@@ -1,18 +1,35 @@
-"""Client for the verifier daemon — retry/backoff over the newline-
-JSON protocol.
+"""Clients for the verifier daemon — retry/backoff over the newline-
+JSON protocol, and consistent-hash routing over a pmux-discovered
+daemon fleet.
 
 ``check`` is pure verification (no side effects on the daemon beyond
 metrics), so a lost connection retries the SAME request safely — the
 cdb2api HA-retry shape without needing replay nonces. Only an
-exhausted retry budget surfaces to the caller.
+exhausted retry budget surfaces to the caller. Overload replies carry
+the daemon's ``retry_after_ms`` hint (queue depth / drain rate);
+:class:`ServiceClient` honors it with JITTERED backoff — fixed-
+interval retries from N clients re-arrive as one synchronized wave
+and shed again.
+
+:class:`RoutedClient` is the horizontal-scale surface: daemons
+register under ``sut/verifier/<shard>`` (``--pmux-shard``), discovery
+reads every registration from ``ct_pmux``, and requests route by
+consistent hash of the history payload — the same history lands on
+the same daemon (warm programs, warm carry pool) and adding a daemon
+remaps only ~1/N of the keyspace. A dead daemon fails over to the
+next on the ring.
 """
 
 from __future__ import annotations
 
+import hashlib
+import random
 import socket
 import time
-from typing import List, Optional, Union
+from bisect import bisect_right
+from typing import Dict, List, Optional, Union
 
+from ..obs.trace import monotonic as _monotonic
 from . import protocol
 from .daemon import PMUX_SERVICE
 
@@ -31,12 +48,17 @@ class ServiceClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 5107,
                  timeout_s: float = 120.0, retries: int = 3,
-                 backoff_s: float = 0.05):
+                 backoff_s: float = 0.05, overload_retries: int = 2):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.retries = retries
         self.backoff_s = backoff_s
+        #: extra attempts on an explicit overload reply, each after a
+        #: jittered sleep around the daemon's retry_after_ms hint
+        #: (0 = surface overload immediately)
+        self.overload_retries = overload_retries
+        self._rng = random.Random()
         self._sock: Optional[socket.socket] = None
         self._file = None
         self._seq = 0
@@ -98,6 +120,35 @@ class ServiceClient:
                       f"unreachable after {self.retries + 1} "
                       f"attempts: {last}")
 
+    def _request_shedding(self, req: dict) -> dict:
+        """One request with overload backoff: an ``overload`` reply
+        sleeps around the daemon's ``retry_after_ms`` hint with
+        +/-50% jitter (N clients backing off the same hint must not
+        re-arrive as one synchronized wave) and retries up to
+        ``overload_retries`` times before surfacing the reply. The
+        request's own ``deadline_ms`` caps the cumulative backoff: a
+        sleep that would blow the caller's budget surfaces the
+        overload instead of silently turning a 100 ms check into a
+        multi-second blocking call."""
+        budget_ms = req.get("deadline_ms")
+        t0 = _monotonic()
+        for attempt in range(self.overload_retries + 1):
+            reply = self._request(req)
+            if (reply.get("ok")
+                    or reply.get("error") != protocol.OVERLOAD
+                    or attempt == self.overload_retries):
+                return reply
+            hint_ms = reply.get("retry_after_ms")
+            if not isinstance(hint_ms, (int, float)) or hint_ms <= 0:
+                hint_ms = 100.0
+            sleep_s = hint_ms / 1e3 * self._rng.uniform(0.5, 1.5)
+            if budget_ms is not None and \
+                    (_monotonic() - t0 + sleep_s) * 1e3 \
+                    > float(budget_ms):
+                return reply
+            time.sleep(sleep_s)
+        return reply
+
     # -- API -----------------------------------------------------------
 
     def check(self, history: Union[str, List, None] = None, *,
@@ -112,10 +163,7 @@ class ServiceClient:
         Returns the reply dict (``valid`` is the tri-state);
         daemon-side errors raise :class:`ServiceError` unless
         ``raise_on_error=False``."""
-        if not isinstance(history, str):
-            from ..ops.history import history_to_edn
-
-            history = history_to_edn(list(history or []))
+        history = _as_edn(history)
         self._seq += 1
         req: dict = {"op": "check", "id": self._seq,
                      "history": history}
@@ -129,7 +177,7 @@ class ServiceClient:
             req["keyed"] = True
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
-        reply = self._request(req)
+        reply = self._request_shedding(req)
         if raise_on_error and not reply.get("ok"):
             raise ServiceError(reply.get("error", "unknown-error"),
                                reply.get("message", ""))
@@ -147,10 +195,7 @@ class ServiceClient:
         returns best-so-far flagged ``partial``. A VALID/UNKNOWN seed
         answers ``bad-request`` (shrinking it is an error, not a
         loop)."""
-        if not isinstance(history, str):
-            from ..ops.history import history_to_edn
-
-            history = history_to_edn(list(history or []))
+        history = _as_edn(history)
         self._seq += 1
         req: dict = {"op": "check", "id": self._seq, "kind": "shrink",
                      "history": history}
@@ -164,7 +209,7 @@ class ServiceClient:
             req["keyed"] = True
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
-        reply = self._request(req)
+        reply = self._request_shedding(req)
         if raise_on_error and not reply.get("ok"):
             raise ServiceError(reply.get("error", "unknown-error"),
                                reply.get("message", ""))
@@ -200,4 +245,164 @@ class ServiceClient:
         self.close()
 
 
-__all__ = ["ServiceClient", "ServiceError"]
+def _hash64(data: bytes) -> int:
+    """Stable 64-bit ring position (md5 prefix — NOT Python's
+    ``hash``, which is salted per process and would re-shuffle the
+    ring every restart)."""
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes: ``nodes_for(key)``
+    yields every distinct node in ring order starting at the key's
+    position — element 0 is the owner, the rest the failover chain.
+    Pure data structure (unit-tested without sockets)."""
+
+    def __init__(self, nodes, replicas: int = 64):
+        if not nodes:
+            raise ValueError("consistent-hash ring needs >= 1 node")
+        self.nodes = sorted(set(nodes))
+        self.replicas = replicas
+        points = []
+        for name in self.nodes:
+            for v in range(replicas):
+                points.append((_hash64(f"{name}#{v}".encode()), name))
+        points.sort()
+        self._points = points
+        self._keys = [h for h, _ in points]
+
+    def nodes_for(self, key: Union[str, bytes]) -> List[str]:
+        if isinstance(key, str):
+            key = key.encode()
+        i = bisect_right(self._keys, _hash64(key)) % len(self._points)
+        out, seen = [], set()
+        for _, name in self._points[i:] + self._points[:i]:
+            if name not in seen:
+                seen.add(name)
+                out.append(name)
+        return out
+
+
+class RoutedClient:
+    """Consistent-hash routing over a fleet of verifier daemons.
+
+    ``endpoints`` maps node name (the pmux service name, e.g.
+    ``sut/verifier/0``) to an open :class:`ServiceClient`. Requests
+    route by their SHAPE CLASS by default (kind | model | pow2 of the
+    history size — everything the client can see of the daemon's
+    shape bucket): same-class traffic coalesces on one daemon, so
+    batch amortization survives routing, and the fleet PARTITIONS the
+    compiled-program space + donated-carry pools instead of every
+    daemon compiling every bucket (``route="payload"`` pins identical
+    histories instead). Adding a daemon remaps only ~1/N of the
+    classes. A node that fails (connect/IO after the client's own
+    retry budget) fails over to the next distinct node on the ring;
+    ``served`` counts per-node routed requests for placement
+    audits."""
+
+    def __init__(self, endpoints: Dict[str, ServiceClient],
+                 replicas: int = 64):
+        if not endpoints:
+            raise ValueError("RoutedClient needs >= 1 endpoint")
+        self.clients = dict(endpoints)
+        self.ring = HashRing(list(endpoints), replicas=replicas)
+        self.served: Dict[str, int] = {n: 0 for n in endpoints}
+        self.failovers = 0
+
+    @classmethod
+    def discover(cls, pmux_port: int = 5105,
+                 prefix: str = PMUX_SERVICE, host: str = "127.0.0.1",
+                 **kw) -> "RoutedClient":
+        """Build the fleet from ct_pmux: every registration named
+        ``<prefix>`` or ``<prefix>/<shard>`` joins the ring (the
+        ``--pmux-shard`` daemons). Raises when none is registered —
+        an empty fleet is an operations failure, not an empty ring."""
+        from ..control.pmux import PmuxClient
+
+        with PmuxClient(host, pmux_port) as c:
+            used = c.used()
+        endpoints = {
+            svc: ServiceClient(host, port, **kw)
+            for svc, port in used.items()
+            if svc == prefix or svc.startswith(prefix + "/")}
+        if not endpoints:
+            raise OSError(
+                f"pmux at {host}:{pmux_port} knows no {prefix!r} "
+                "daemons")
+        return cls(endpoints)
+
+    def _route(self, key: Union[str, bytes], fn):
+        last: Optional[Exception] = None
+        for name in self.ring.nodes_for(key):
+            try:
+                out = fn(self.clients[name])
+            except OSError as e:
+                last = e
+                self.failovers += 1
+                continue
+            self.served[name] += 1
+            return out
+        raise OSError(f"every daemon on the ring failed: {last}")
+
+    @staticmethod
+    def route_key(history: str, kind: str = "check",
+                  model: Optional[str] = None,
+                  route: str = "shape") -> str:
+        """The ring key for one request. ``"shape"`` (default) is the
+        client-visible shape class — kind, model, and the pow2 size
+        class of the EDN payload — so a daemon owns whole bucket
+        classes; ``"payload"`` hashes the full history (identical
+        histories pin, every bucket scatters across the fleet)."""
+        if route == "payload":
+            return f"{kind}|{model or ''}|{history}"
+        size = max(len(history), 1)
+        return f"{kind}|{model or ''}|{1 << (size - 1).bit_length()}"
+
+    def check(self, history: Union[str, List, None] = None, *,
+              route: str = "shape", **kw) -> dict:
+        history = _as_edn(history)
+        key = self.route_key(history, "txn" if kw.get("txn")
+                             else "check", kw.get("model"), route)
+        return self._route(key, lambda c: c.check(history, **kw))
+
+    def shrink(self, history: Union[str, List, None] = None, *,
+               route: str = "shape", **kw) -> dict:
+        history = _as_edn(history)
+        key = self.route_key(history, "shrink", kw.get("model"),
+                             route)
+        return self._route(key, lambda c: c.shrink(history, **kw))
+
+    def statuses(self) -> Dict[str, dict]:
+        """Per-daemon status (skipping unreachable nodes)."""
+        out = {}
+        for name, c in self.clients.items():
+            try:
+                out[name] = c.status()["status"]
+            except OSError:
+                pass
+        return out
+
+    def ping_all(self) -> Dict[str, bool]:
+        return {name: c.ping() for name, c in self.clients.items()}
+
+    def close(self) -> None:
+        for c in self.clients.values():
+            c.close()
+
+    def __enter__(self) -> "RoutedClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _as_edn(history) -> str:
+    if isinstance(history, str):
+        return history
+    from ..ops.history import history_to_edn
+
+    return history_to_edn(list(history or []))
+
+
+__all__ = ["HashRing", "RoutedClient", "ServiceClient",
+           "ServiceError"]

@@ -1,17 +1,23 @@
 """The verifier daemon — a single-threaded selector loop over
 :class:`~.core.VerifierCore`.
 
-Coalescing policy: requests queue while the loop keeps seeing new
-bytes; a tick fires when (a) the oldest queued request has waited the
-coalesce window, (b) the queue reached the batch cap, or (c) a select
-round went idle (so a lone serial caller is answered immediately
-instead of always paying the window). Device dispatches run inline on
-this same thread — the container has ONE CPU, and the whole point is
-one dispatch per tick, so there is nothing to overlap with.
+Continuous-batching loop (round 9): every selector round pumps the
+core — requests slot into their buckets as the bytes arrive, a full
+batch launches inside ``submit`` itself, and the pump launches
+whatever bucket's launch budget came due. A quiet round (no bytes)
+pumps with ``idle=True``: every forming batch launches and the
+in-flight ring drains, so a lone serial caller is answered
+immediately instead of paying the fill window. Device dispatches are
+STAGED on this thread and finalize through the core's bounded ring —
+the overlap is host-pack vs async device compute (one CPU; never
+multiprocessing).
 
 Discovery: with ``--pmux``, the daemon publishes its port under
-``sut/verifier`` through the same ``ct_pmux`` path the native SUT
-uses (``control/pmux.py``); clients then resolve the service by name.
+``sut/verifier`` (or ``sut/verifier/<shard>`` for a horizontally
+scaled fleet — ``--pmux-shard``) through the same ``ct_pmux`` path
+the native SUT uses (``control/pmux.py``); clients then resolve the
+service by name, and :class:`~.client.RoutedClient` consistent-hash
+routes over every registered daemon.
 
 Observability: ``{"op": "status"}`` returns the status JSON on the
 same socket and ``{"op": "metrics"}`` (or ``kind:"metrics"`` on the
@@ -54,18 +60,23 @@ class VerifierDaemon:
     """One listening socket, N client connections, one tick loop."""
 
     def __init__(self, core: VerifierCore, host: str = "127.0.0.1",
-                 port: int = 0, coalesce_s: float = 0.005,
+                 port: int = 0, coalesce_s: Optional[float] = None,
                  pmux_port: Optional[int] = None,
                  pmux_service: str = PMUX_SERVICE,
                  store_root: Optional[str] = None,
                  artifact_interval_s: float = 30.0):
         self.core = core
-        self.coalesce_s = coalesce_s
+        if coalesce_s is not None:
+            # legacy knob: the coalesce window is now the core's
+            # per-bucket fill window (a cap on batch formation, not a
+            # tick round)
+            core.fill_window_s = max(float(coalesce_s), 0.0)
         self.pmux_port = pmux_port
         self.pmux_service = pmux_service
         self.store_root = store_root
         self.artifact_interval_s = artifact_interval_s
         self._stop = False
+        self._published = False
         self._dropped_replies = 0
         self._sel = selectors.DefaultSelector()
         self._conns: Dict[int, _Conn] = {}
@@ -80,6 +91,12 @@ class VerifierDaemon:
 
     # -- lifecycle -----------------------------------------------------
 
+    @property
+    def published(self) -> bool:
+        """Whether the pmux registration actually happened (the ready
+        line reports ``pmux_service`` null when it did not)."""
+        return self._published
+
     def stop(self, *_args) -> None:
         self._stop = True
 
@@ -91,9 +108,13 @@ class VerifierDaemon:
                 timeout = self._select_timeout()
                 got_bytes = self._pump(timeout)
                 now = obs.monotonic()
-                if self._should_tick(now, got_bytes):
-                    for p, reply in self.core.tick(now):
-                        self._send(p.ctx, reply)
+                # the scheduler beat: launch due buckets; on a quiet
+                # round (no new bytes) launch everything forming and
+                # drain the in-flight ring — serial callers never
+                # wait out the fill window
+                for p, reply in self.core.pump(now,
+                                               idle=not got_bytes):
+                    self._send(p.ctx, reply)
                 if self.store_root is not None and \
                         now - last_artifact >= self.artifact_interval_s:
                     self._save_artifact()
@@ -101,28 +122,20 @@ class VerifierDaemon:
         finally:
             self._shutdown()
 
-    #: with work queued, select() sleeps at most this long — an empty
-    #: probe round means traffic went quiet, and the idle flush fires
-    #: the tick instead of making a lone serial caller wait out the
-    #: whole coalesce window
+    #: with work queued (forming batches, host/shrink work, staged
+    #: dispatches), select() sleeps at most this long — the pump then
+    #: sees either new bytes (keep filling) or a quiet round (launch +
+    #: drain)
     IDLE_PROBE_S = 0.001
 
     def _select_timeout(self) -> Optional[float]:
-        if self.core.queue:
-            oldest = self.core.queue[0].t_in
-            remaining = max(0.0, oldest + self.coalesce_s
-                            - obs.monotonic())
-            return min(remaining, self.IDLE_PROBE_S)
+        if self.core.queue_depth() or self.core.inflight():
+            nxt = self.core.next_event_at()
+            if nxt is not None:
+                return min(max(nxt - obs.monotonic(), 0.0),
+                           self.IDLE_PROBE_S)
+            return self.IDLE_PROBE_S
         return 0.5
-
-    def _should_tick(self, now: float, got_bytes: bool) -> bool:
-        q = self.core.queue
-        if not q:
-            return False
-        return (len(q) >= self.core.batch_cap
-                or now - q[0].t_in >= self.coalesce_s
-                or not got_bytes)        # idle flush: serial callers
-        # never wait out the window when no more traffic is arriving
 
     # -- socket plumbing -----------------------------------------------
 
@@ -252,13 +265,18 @@ class VerifierDaemon:
     # -- discovery / artifacts -----------------------------------------
 
     def _pmux_publish(self) -> None:
-        if self.pmux_port is None:
+        """Idempotent: ``__main__`` publishes BEFORE printing the
+        ready line (ready must mean discoverable — a fleet booter
+        races discovery against it), ``run()`` keeps the call for
+        embedders driving the daemon directly."""
+        if self.pmux_port is None or self._published:
             return
         from ..control.pmux import PmuxClient
 
         try:
             with PmuxClient(port=self.pmux_port) as c:
                 c.use(self.pmux_service, self.port)
+            self._published = True
             logger.info("published %s -> %d via pmux:%d",
                         self.pmux_service, self.port, self.pmux_port)
         except OSError as e:

@@ -28,6 +28,15 @@ DISPATCHES = 0
 #: compile-surface guard diffs it (utils/compile_guard.py)
 COMPILES = 0
 
+# NOTE on carry donation (continuous-batching round): the closure
+# kernels deliberately do NOT donate their packed upload. jit donation
+# aliases inputs to OUTPUTS only, and the (B, 4, N, N/8) uint8 operand
+# can never alias the (B, 3, N) bool diagonal readback — donating it
+# would be a guaranteed no-op that logs a "donated buffers were not
+# usable" warning per program class. The stream kernel's carries
+# (checker/pallas_seg) DO donate: there the scan carry shapes equal
+# the output shapes exactly.
+
 
 def _jnp():
     import jax.numpy as jnp
@@ -164,6 +173,18 @@ def closure_diag_batch(adjs: np.ndarray, mesh=None,
     multiple of D with all-zero adjacencies — acyclic by construction,
     their diagonals read all-False and are sliced off before return,
     so a pad graph can never surface as a verdict."""
+    return closure_diag_batch_async(adjs, mesh=mesh,
+                                    batch_axis=batch_axis)()
+
+
+def closure_diag_batch_async(adjs: np.ndarray, mesh=None,
+                             batch_axis: str = "batch"):
+    """Stage the batched closure and return a zero-argument
+    ``finalize()`` producing the (B, 3, N) diagonal mask — the
+    stage/finalize seam the service's in-flight ring rides: between
+    stage and finalize the squaring loop runs asynchronously on
+    device, so the tick can pack the NEXT bucket's operands (or stage
+    a check-kind dispatch) while this one squares."""
     global DISPATCHES
     n = adjs.shape[-1]
     B = adjs.shape[0]
@@ -181,10 +202,10 @@ def closure_diag_batch(adjs: np.ndarray, mesh=None,
             adjs = np.concatenate([adjs, pad])
         out = _jitted_sharded(n, mesh, batch_axis)(_pack(adjs))
         DISPATCHES += 1
-        return np.asarray(out)[:B]
+        return lambda: np.asarray(out)[:B]
     out = _jitted(n)(_pack(adjs))
     DISPATCHES += 1
-    return np.asarray(out)
+    return lambda: np.asarray(out)
 
 
 def cyclic_layers_device(adj: np.ndarray,
@@ -205,4 +226,5 @@ def cyclic_layers_device(adj: np.ndarray,
 
 
 __all__ = ["COMPILES", "DISPATCHES", "closure_diag",
-           "closure_diag_batch", "cyclic_layers_device"]
+           "closure_diag_batch", "closure_diag_batch_async",
+           "cyclic_layers_device"]

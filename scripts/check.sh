@@ -271,6 +271,87 @@ if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
     exit 1
 fi
 
+stage routing "pmux-routed two-daemon fleet smoke"
+# the horizontal-scale path end to end: two daemons register under
+# ct_pmux (sut/verifier/0, sut/verifier/1), the consistent-hash
+# client discovers them and routes 8 mixed-shape requests — BOTH
+# daemons must serve traffic, and everything must shut down clean
+# with no zombies (docs/service.md "Horizontal scale-out")
+ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+RT_PMUX_PORT=${CT_CHECK_ROUTING_PMUX_PORT:-15106}
+ASAN_OPTIONS=halt_on_error=1 "$PMUX" -p "$RT_PMUX_PORT" &
+RT_PMUX_PID=$!
+RT_LOG0=$(mktemp); RT_LOG1=$(mktemp)
+CLEANUP_PIDS="$RT_PMUX_PID"
+for _ in $(seq 50); do
+    if bash -c "true >/dev/tcp/127.0.0.1/$RT_PMUX_PORT" 2>/dev/null; then
+        break
+    fi
+    sleep 0.1
+done
+JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
+    --backend cpu --no-prime --frontier 64 \
+    --pmux "$RT_PMUX_PORT" --pmux-shard 0 >"$RT_LOG0" 2>&1 &
+RT_PID0=$!
+CLEANUP_PIDS="$RT_PMUX_PID $RT_PID0"
+JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
+    --backend cpu --no-prime --frontier 64 \
+    --pmux "$RT_PMUX_PORT" --pmux-shard 1 >"$RT_LOG1" 2>&1 &
+RT_PID1=$!
+CLEANUP_PIDS="$RT_PMUX_PID $RT_PID0 $RT_PID1"
+for LOG in "$RT_LOG0" "$RT_LOG1"; do
+    for _ in $(seq 200); do
+        grep -q '"ready"' "$LOG" 2>/dev/null && break
+        sleep 0.1
+    done
+    grep -q '"ready"' "$LOG" || { echo "routing daemon never ready" >&2; \
+        cat "$LOG" >&2; exit 1; }
+done
+RT_PMUX_PORT="$RT_PMUX_PORT" python - <<'EOF'
+import os, random
+from comdb2_tpu.ops.history import history_to_edn
+from comdb2_tpu.ops.synth import register_history
+from comdb2_tpu.service.client import RoutedClient
+
+rc = RoutedClient.discover(pmux_port=int(os.environ["RT_PMUX_PORT"]),
+                           timeout_s=300.0, retries=5, backoff_s=0.5)
+assert set(rc.clients) == {"sut/verifier/0", "sut/verifier/1"}, \
+    sorted(rc.clients)
+# 8 requests across enough size classes that the shape-class ring
+# provably touches both daemons (class->daemon is deterministic md5)
+for i, n_events in enumerate((10, 18, 30, 60, 10, 18, 30, 60)):
+    h = register_history(random.Random(100 + i), 3, n_events,
+                         p_info=0.0)
+    r = rc.check(history_to_edn(h))
+    assert r.get("ok") and r.get("valid") is True, r
+assert all(v > 0 for v in rc.served.values()), \
+    f"a daemon served nothing: {rc.served}"
+sts = rc.statuses()
+assert len(sts) == 2 and \
+    all(st["completed"] >= 1 for st in sts.values()), sts
+for c in rc.clients.values():
+    assert c.shutdown()
+EOF
+wait "$RT_PID0"
+wait "$RT_PID1"
+exec 3<>"/dev/tcp/127.0.0.1/$RT_PMUX_PORT"
+printf 'exit\n' >&3
+cat <&3 >/dev/null || true
+exec 3<&- 3>&-
+wait "$RT_PMUX_PID"
+CLEANUP_PIDS=""
+rm -f "$RT_LOG0" "$RT_LOG1"
+if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
+    echo "routing smoke left a daemon behind" >&2
+    exit 1
+fi
+ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
+if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+    echo "routing smoke left a zombie" \
+         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
+    exit 1
+fi
+
 stage obs "tracing + metrics plane smoke (daemon --trace --store)"
 # boot with tracing on, run one check + one shrink, scrape the
 # metrics (kind:"metrics"), then assert the shutdown trace artifact
@@ -343,6 +424,7 @@ if [ "$JSON_MODE" = 0 ]; then
          "analysis clean, ct_pmux shutdown clean, txn smoke caught" \
          "the seeded cycle, shrink smoke reached the known minimum," \
          "multichip dryrun bit-identical across the mesh," \
-         "verifier service shutdown clean, obs smoke traced a" \
-         "check+shrink with populated histograms"
+         "verifier service shutdown clean, two-daemon pmux routing" \
+         "served on both shards, obs smoke traced a check+shrink" \
+         "with populated histograms"
 fi

@@ -236,6 +236,78 @@ def test_reply_stages_tile_latency():
     assert st["transfer_bytes"]["h2d"] > 0
 
 
+def test_expired_reply_stages_tile_latency():
+    """The expiry path keeps the attribution contract: a deadline-
+    expired request's reply carries ALL four stages (its whole wait
+    is queue wait; the rest observe as 0), sum(stages) tiles its
+    latency_ms, and the stage histograms share the latency
+    histogram's count — the 153-vs-258 count mismatch this round
+    fixed."""
+    from comdb2_tpu.ops.synth import register_history
+    from comdb2_tpu.service.core import STAGES
+
+    core = _core()
+    h = register_history(random.Random(9), 3, 24, p_info=0.0)
+    _submit(core, h, deadline_ms=0)       # expired on arrival
+    _submit(core, h)
+    time.sleep(0.002)
+    done = core.tick()
+    expired = next(r for _, r in done if r["valid"] == "unknown")
+    assert expired["cause"] == "deadline"
+    stages = expired["stages"]
+    assert set(stages) == set(STAGES)
+    assert stages["queue_wait_ms"] > 0
+    assert stages["host_pack_ms"] == stages["device_ms"] == \
+        stages["finalize_ms"] == 0.0
+    total = sum(stages.values())
+    assert abs(total - expired["latency_ms"]) <= \
+        max(0.1 * expired["latency_ms"], 5.0), expired
+    # histogram counts tile: every stage series counts EVERY
+    # completed request, expiries included
+    snap = core.metrics_reply()["metrics"]
+    n_lat = snap["service_latency_ms"]["series"][0]["count"]
+    assert n_lat == len(done) == 2
+    for s in STAGES:
+        name = "service_" + s.replace("_ms", "") + "_ms"
+        assert snap[name]["series"][0]["count"] == n_lat, name
+
+
+def test_expired_shrink_partial_stages_tile_latency():
+    """A shrink job cut by its deadline BETWEEN rounds charges the
+    final re-queue wait to queue_wait, so the partial reply's stages
+    still tile its latency (review regression — real clocks
+    throughout, the stage math and the expiry share one timebase)."""
+    import random as _random
+
+    from comdb2_tpu.ops.history import history_to_edn
+    from comdb2_tpu.ops.synth import inject_anomaly, register_history
+    from comdb2_tpu.service.core import STAGES
+
+    core = _core()
+    base = register_history(_random.Random(23), 3, 200,
+                            fs=("write",), p_info=0.0)
+    h, _ = inject_anomaly(base, "stale-read")
+    _, reply = core.submit(
+        {"op": "check", "kind": "shrink", "id": 3,
+         "history": history_to_edn(h), "deadline_ms": 50},
+        time.monotonic())
+    assert reply is None
+    deadline = time.monotonic() + 120
+    done = []
+    while not done and time.monotonic() < deadline:
+        done = core.pump(time.monotonic())
+    (_, r), = done
+    if not r.get("partial"):
+        pytest.skip("minimization finished inside the deadline — "
+                    "nothing expired between rounds")
+    assert r["cause"] == "deadline"
+    stages = r["stages"]
+    assert set(stages) == set(STAGES)
+    total = sum(stages.values())
+    assert abs(total - r["latency_ms"]) <= \
+        max(0.1 * r["latency_ms"], 5.0), r
+
+
 def test_priming_stays_out_of_the_histograms():
     core = _core()
     core.prime(specs=((24, 2),), seed=41)
