@@ -200,6 +200,19 @@ def _ladders() -> dict:
 
     lim = ServiceLimits()
     specs = [spec for _, _, _, spec in production_tiers()]
+    from ..checker import mxu
+    from ..checker.linear_jax import make_pack_plan
+
+    # every PackPlan word count reachable inside the MXU table caps —
+    # the chunk form's carry exposes one (F,)-shaped word column per
+    # plan word, so the template set enumerates W
+    mxu_words = sorted({
+        plan.n_words
+        for ns in (1 << i for i in range(mxu.S_CAP.bit_length()))
+        for nt in (1 << i for i in range(mxu.T_CAP.bit_length()))
+        for P in range(1, mxu.MAX_P + 1)
+        for plan in (make_pack_plan(ns, nt, P),)
+        if plan is not None})
     return {
         "limits": lim,
         "fuzz_buckets": tuple(PRODUCTION_BUCKETS),
@@ -220,6 +233,11 @@ def _ladders() -> dict:
         "shrink_B": (1, MAX_BATCH),
         "batch_B": (1, 1 << 12),
         "memo_dim": (1, 1 << 20),
+        "mxu_table": (mxu.S_CAP, mxu.T_CAP),
+        "mxu_F": tuple(mxu.CAPACITIES),
+        "mxu_chunk": (64, mxu.CHUNK),
+        "mxu_P": (mxu.MIN_P, mxu.MAX_P),
+        "mxu_words": tuple(mxu_words),
     }
 
 
@@ -297,6 +315,32 @@ def static_inventory() -> Inventory:
     N8 = Axis("N/8", "pow2", L["txn_N"][0] // 8, L["txn_N"][1] // 8)
     txn_B = Axis("B", "pow2", 1, 1 << 12)
 
+    mxu_S = Axis("mxu_n_states", "pow2", 1, L["mxu_table"][0])
+    mxu_T = Axis("mxu_n_transitions", "pow2", 1, L["mxu_table"][1])
+    mxu_F = Axis("F", "enum", values=L["mxu_F"])
+    mxu_chunk_ax = Axis("mxu_chunk", "pow2", *L["mxu_chunk"])
+    mxu_words_ax = Axis("n_words", "enum", values=L["mxu_words"])
+    # a genuinely concurrent wide-P wave puts up to P invokes in one
+    # segment, so the engine's K axis runs to MAX_P (the kernel's
+    # K <= 8 cap is a Mosaic budget, not an XLA/MXU one)
+    mxu_K = Axis("mxu_K", "pow2", 1, L["mxu_P"][1])
+    # batch form: succ + (S, B, K) segment tensors, like keys/flat
+    mxu_batch_tmpl = ((mxu_S, mxu_T), (S, B, mxu_K), (S, B, mxu_K),
+                      (S, B), (S,))
+    # single-history form (the driver's non-chunked path)
+    mxu_single_tmpl = ((mxu_S, mxu_T), (S, mxu_K), (S, mxu_K), (S,),
+                       (S,))
+    # chunk form: args + seg_offset scalar + the B=1 carry — n_words
+    # (F,) packed word columns, (F,) valid, then n_b/status/fail (1,)
+    mxu_chunk_tmpls = []
+    for W in L["mxu_words"]:
+        mxu_chunk_tmpls.append(
+            ((mxu_S, mxu_T), (mxu_chunk_ax, mxu_K),
+             (mxu_chunk_ax, mxu_K), (mxu_chunk_ax,), (mxu_chunk_ax,),
+             ())
+            + ((mxu_F,),) * W
+            + ((mxu_F,), (one,), (one,), (one,)))
+
     sites = (
         Site(
             key="pallas-stream-scan",
@@ -337,6 +381,26 @@ def static_inventory() -> Inventory:
                  "shard compiles B/D lanes",
             templates=(xla_batch_seg,),
             axes_doc=(memo, S, B, K),
+        ),
+        Site(
+            key="mxu-frontier",
+            jit_names=("check_device_mxu_batch", "check_device_mxu",
+                       "check_device_mxu_chunk"),
+            note="MXU frontier engine (checker/mxu): BFS-as-matmul "
+                 "closure for wide-P histories — packed-word frontier, "
+                 "bf16/f32 one-hot expansion on the MXU, exact "
+                 "packed-key lexsort dedup. Batch form takes the same "
+                 "(S, B, K) segment tensors as keys/flat; table dims "
+                 "are pow2 inside the matmul caps (S_CAP x T_CAP). "
+                 "The chunk form's carry exposes the frontier as "
+                 "n_words (F,) word columns with F drawn from the "
+                 "CAPACITIES ladder (in-place escalation rungs); P is "
+                 "a static arg bucketed by the caller (driver "
+                 "even-buckets, batch pow2-buckets, P <= MAX_P)",
+            templates=(mxu_batch_tmpl, mxu_single_tmpl)
+            + tuple(mxu_chunk_tmpls),
+            axes_doc=(mxu_S, mxu_T, S, B, mxu_K, mxu_F, mxu_chunk_ax,
+                      mxu_words_ax),
         ),
         Site(
             key="xla-batch-vmap",
@@ -442,6 +506,15 @@ def _witness_specs():
         return jax.eval_shape(CJ._jitted(16),
                               st((4, 16, 2), np.uint8))
 
+    def mxu_witness():
+        from ..checker import mxu as MXU
+
+        fn = functools.partial(MXU.check_device_mxu_batch, B=2,
+                               F=1024, P=16, n_states=32,
+                               n_transitions=32)
+        return jax.eval_shape(fn, st((32, 32)), st((8, 2, 2)),
+                              st((8, 2, 2)), st((8, 2)), st((8,)))
+
     def _witness_mesh():
         # a 1-device mesh: available on every platform, and the D=1
         # rung keeps the artifact deterministic across environments
@@ -495,6 +568,9 @@ def _witness_specs():
         ("xla-batch-engines",
          "check_device_keys_sharded: same shapes, D=1 mesh rung",
          keys_sharded_witness),
+        ("mxu-frontier",
+         "check_device_mxu_batch at (32,32) S=8 B=2 K=2 P=16 F=1024",
+         mxu_witness),
         ("txn-closure", "closure bucket N=16", closure_witness),
         ("txn-closure",
          "closure_diag_kernel_sharded: B=2 N=16, D=1 mesh rung",
@@ -610,6 +686,19 @@ def render_programs() -> str:
         "(8,128)/(16,128) tiers |",
         f"| kernel table rows | {list(L['kernel_table_rows'])} | "
         "`table_rows_pad` buckets |",
+        f"| mxu table caps | pow2 1..{L['mxu_table'][0]} x pow2 1.."
+        f"{L['mxu_table'][1]} | `checker.mxu.S_CAP/T_CAP` (bf16 "
+        "value-plane exactness bound) |",
+        f"| mxu frontier F | {list(L['mxu_F'])} | "
+        "`checker.mxu.CAPACITIES` (in-place escalation rungs; top "
+        "rung = the wide-P honest-UNKNOWN threshold) |",
+        f"| mxu chunk | pow2 {L['mxu_chunk'][0]}..{L['mxu_chunk'][1]}"
+        " | `checker.mxu.CHUNK` |",
+        f"| mxu P crossover | {L['mxu_P'][0]}..{L['mxu_P'][1]} | "
+        "`checker.mxu.MIN_P/MAX_P` (static arg — driver even-buckets, "
+        "batch pow2-buckets) |",
+        f"| mxu key words | {list(L['mxu_words'])} | "
+        "`PackPlan.n_words` over the table caps x P |",
         "",
         "## Dispatch sites",
         "",
@@ -673,6 +762,8 @@ SHAPE_SINKS: Dict[str, dict] = {
     "check_device_keys": {"kwargs": ("n_states", "n_transitions")},
     "check_device_flat": {"kwargs": ("n_states", "n_transitions")},
     "check_device_seg_batch": {"kwargs": ("n_states",
+                                          "n_transitions")},
+    "check_device_mxu_batch": {"kwargs": ("n_states",
                                           "n_transitions")},
     "check_device_batch": {"kwargs": ("n_states", "n_transitions")},
     "check_device_pallas_stream": {"kwargs": ("n_states",

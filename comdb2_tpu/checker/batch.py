@@ -22,6 +22,7 @@ from ..ops.op import FAIL, INVOKE, OK, Op
 from ..ops.packed import PackedHistory, pack_history
 from ..utils import next_pow2 as _next_pow2
 from . import linear_jax as LJ
+from . import mxu as MXU
 from . import pallas_seg as PSEG
 
 #: device->host verdict readback per history: status int32 + fail
@@ -643,9 +644,15 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
         # under a mesh each device sees B_pad/D histories — the fits
         # budgets apply to the per-shard batch. ``b`` overrides the
         # batch size (escalated sub-batches are far smaller than the
-        # full batch, so their budgets fit where the batch's don't)
+        # full batch, so their budgets fit where the batch's don't).
+        # Wide P goes to the MXU frontier engine first: past the
+        # crossover (mxu.MIN_P) its matmul expansion is P-independent
+        # while the keys/flat per-iteration cost scales with P — and
+        # most wide-P shapes don't fit the 62-bit key budgets at all
         if b is None:
             b = B_pad // D if D > 1 else B
+        if MXU.serves(sizes["n_states"], sizes["n_transitions"], P):
+            return "mxu"
         if LJ.KeyLayout(b, sizes["n_states"],
                         sizes["n_transitions"], P).fits:
             return "keys"
@@ -755,6 +762,48 @@ def _check_batch_begin(batch: PackedBatch, F: int, mesh,
 
             return finalize_stream
         engine = pick_xla_engine()
+    if engine == "mxu":
+        # the MXU frontier engine's batched form: packed-word frontier,
+        # matmul expansion, exact packed-key dedup — same (S, B, K)
+        # segment tensors as keys/flat. No shard_map form yet: a mesh
+        # caller runs one device and says so (like the vmap fallback)
+        assert MXU.fits(sizes["n_states"], sizes["n_transitions"],
+                        P), \
+            "mxu engine requires the table caps and a lossless " \
+            "PackPlan (see mxu.fits)"
+        note("mxu")
+        if mesh is not None and info is not None:
+            info["mesh_dropped"] = True
+        # bucket the caller's F to the engine's CAPACITIES ladder —
+        # the PROGRAMS.md mxu-frontier site declares F as a closed
+        # enum, and F is jit-static but invisible in the input avals,
+        # so per-caller F churn would compile unseen extra programs
+        # (check_batch's default F=256 rounds up to the 1024 rung)
+        F_mxu = MXU.bucket_F(F)
+        if info is not None:
+            info["frontier_capacity"] = F_mxu
+        sb = segment_batch(batch, streams=prebuilt_streams,
+                           s_pad=s_pad, k_pad=k_pad)
+        if info is not None:
+            info["transfer_bytes"] = {
+                "h2d": int(succ.nbytes) + int(sb.inv_proc.nbytes)
+                + int(sb.inv_tr.nbytes) + int(sb.ok_proc.nbytes)
+                + int(sb.depth.nbytes),
+                "d2h": B * _D2H_BYTES_PER_LANE}
+        status_d, fail_seg_d, n_final_d = MXU.check_device_mxu_batch(
+            succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+            B=B, F=F_mxu, P=P, **sizes)
+
+        @_obs.traced("batch.finalize")
+        def finalize_mxu():
+            status = np.asarray(status_d)[:B]
+            fail_seg = np.asarray(fail_seg_d)[:B]
+            fail_at = np.array([
+                sb.seg_index[b, fail_seg[b]] if fail_seg[b] >= 0
+                else -1 for b in range(B)], np.int64)
+            return status, fail_at, np.asarray(n_final_d)[:B]
+
+        return finalize_mxu
     if engine in ("keys", "flat"):
         note(engine if mesh is None else engine + "-sharded")
         sb = segment_batch(batch, streams=prebuilt_streams,

@@ -68,7 +68,10 @@ def analysis(model: Model,
 
     backend: "auto" | "host" | "device".
     capacities: device frontier sizes tried in order; overflow escalates,
-    overflow at the last yields :unknown.
+    overflow at the last yields :unknown. The MXU arm (wide P) buckets
+    each entry up to its own pow2 rung set (``mxu.CAPACITIES``) so its
+    program surface stays closed — the ladder still starts and stops
+    where the caller's bounds say.
     progress: optional callback ``progress(done_segments, total_segments,
     frontier_count, stats)`` invoked between device chunks at roughly
     ``progress_interval_s`` cadence — the role of the reference's
@@ -211,6 +214,26 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
             info["time_s"] = _obs.monotonic() - t0
             return _device_verdict(mm, packed, segs, status, fail_seg,
                                    n_final, info)
+        # kernel overflow: record the attempt, then escalate — the
+        # final artifact must say WHICH engine produced the verdict
+        # and what was tried on the way (a wide-P UNKNOWN used to be
+        # indistinguishable from a capacity abort in filetest output)
+        _note_tried(info, "pallas-fused", PSEG.F)
+
+    # MXU frontier engine: P past the fused kernel's tiers but with
+    # bounded in-flight (remap_slots makes P the max CONCURRENT open
+    # calls) rides BFS-as-matmul expansion with the exact packed-key
+    # dedup — its capacity ladder tops out at 2x the XLA ladder's, so
+    # wide-P workloads that overflowed 65536 now get verdicts
+    # (docs/architecture.md "The engine ladder").
+    from . import mxu as MXU
+
+    if MXU.serves(mm.n_states, mm.n_transitions, P2):
+        return _analyze_mxu(mm, packed, segs, succ, P2, t0, info,
+                            capacities=capacities,
+                            progress=progress,
+                            progress_interval_s=progress_interval_s,
+                            s_real=s_real)
 
     # the adaptive engine's small tier: most segments' closed frontiers
     # are tiny (p50 ~ 8 configs on the register bench), so each segment
@@ -298,6 +321,112 @@ def _analyze_device(mm: MemoizedModel, packed: PackedHistory,
                            info)
 
 
+def _note_tried(info: dict, engine: str, capacity) -> None:
+    """Record an engine attempt that did NOT produce the verdict —
+    the artifact's ``engines_tried`` trail makes a wide-P UNKNOWN
+    distinguishable from a capacity abort (each entry names the
+    engine and the frontier capacity it gave up at)."""
+    info.setdefault("engines_tried", []).append(
+        {"engine": engine, "frontier_capacity": capacity})
+
+
+@_obs.traced("linear.mxu")
+def _analyze_mxu(mm: MemoizedModel, packed: PackedHistory, segs, succ,
+                 P: int, t0: float, info: dict,
+                 capacities: Sequence[int] = None, progress=None,
+                 progress_interval_s: float = 5.0,
+                 s_real: int = None) -> Analysis:
+    """The MXU frontier engine's driver arm: capacity ladder over
+    ``mxu.CAPACITIES`` with the same chunked / in-place-escalation
+    discipline as the XLA arm (an overflow widens the PRE-chunk carry
+    and re-runs only the overflowing chunk). Terminal for the shapes
+    it serves: its top rung is 2x the XLA ladder's, so there is no
+    wider engine to fall through to — overflow past it is the honest
+    UNKNOWN, attributed to this engine in the artifact.
+
+    ``capacities`` is the caller's ``analysis(capacities=...)`` bound:
+    each entry buckets UP to the smallest ``mxu.CAPACITIES`` rung that
+    holds it (the program surface stays closed on the declared rungs)
+    and the ladder runs only those rungs — a caller bounding device
+    work can force an early UNKNOWN here exactly like on the XLA arm.
+    """
+    import numpy as np
+
+    from . import linear_jax as LJ
+    from . import mxu as MXU
+
+    if capacities is None:
+        ladder = tuple(MXU.CAPACITIES)
+    else:
+        ladder = tuple(sorted({MXU.bucket_F(f) for f in capacities}))
+
+    info["engine"] = "mxu-frontier"
+    sizes = {"n_states": mm.n_states, "n_transitions": mm.n_transitions}
+    S = segs.ok_proc.shape[0]
+    if s_real is None:
+        s_real = S
+    chunked = (progress is not None or S > CHUNKED_S_THRESHOLD)
+    if not chunked:
+        for F in ladder:
+            status, fail_seg, n_final = MXU.check_device_mxu(
+                succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
+                segs.depth, F=F, P=P, **sizes)
+            status = int(status)
+            info["frontier_capacity"] = F
+            if status != LJ.UNKNOWN:
+                break
+    else:
+        chunk = max(_next_pow2(min(S, MXU.CHUNK)), 64)
+        cap_ix = 0
+        F = ladder[cap_ix]
+        carry = MXU.init_carry(1, F, P, **sizes)
+        t_run = _obs.monotonic()
+        last = t_run
+        done = 0
+        visited = 0
+        while done < S:
+            end = min(done + chunk, S)
+            pad = chunk - (end - done)
+            ip = np.pad(segs.inv_proc[done:end],
+                        ((0, pad), (0, 0)), constant_values=-1)
+            it = np.pad(segs.inv_tr[done:end], ((0, pad), (0, 0)))
+            op_ = np.pad(segs.ok_proc[done:end], (0, pad),
+                         constant_values=-1)
+            dp = np.pad(segs.depth[done:end], (0, pad))
+            new_carry = MXU.check_device_mxu_chunk(
+                succ, ip, it, op_, dp, done, carry, F=F, P=P, **sizes)
+            st = int(new_carry[3][0])
+            if st == LJ.UNKNOWN and cap_ix + 1 < len(ladder):
+                cap_ix += 1
+                F = ladder[cap_ix]
+                carry = MXU.expand_carry(carry, F)
+                continue            # same chunk, wider frontier
+            carry = new_carry
+            visited += int(carry[2][0]) * (end - done)
+            done = end
+            if st != LJ.VALID:
+                break
+            now = _obs.monotonic()
+            if progress is not None and \
+                    now - last >= progress_interval_s:
+                hist = np.asarray(MXU.pending_histogram(
+                    carry[0], carry[1], P=P, **sizes))
+                el = max(now - t_run, 1e-9)
+                # report against the REAL segment count like the XLA
+                # arm — S here is the pow2-padded axis
+                progress(min(done, s_real), s_real, int(carry[2][0]),
+                         {"visited_per_s": visited / el,
+                          "segs_per_s": done / el,
+                          "est_cost": LJ.estimated_cost_hist(hist)})
+                last = now
+        status, fail_seg, n_final = (int(carry[3][0]), carry[4][0],
+                                     carry[2][0])
+        info["frontier_capacity"] = F
+    info["time_s"] = _obs.monotonic() - t0
+    return _device_verdict(mm, packed, segs, status, fail_seg, n_final,
+                           info)
+
+
 def _device_verdict(mm, packed, segs, status, fail_seg, n_final,
                     info) -> Analysis:
     """Decode an engine's (status, fail_segment, n) into an Analysis."""
@@ -308,8 +437,14 @@ def _device_verdict(mm, packed, segs, status, fail_seg, n_final,
     if status == LJ.VALID:
         return Analysis(valid=True, final_count=int(n_final), info=info)
     if status == LJ.UNKNOWN:
+        # attribute the give-up: which engine, at what capacity (plus
+        # the engines_tried trail) — a wide-P overflow and a kernel
+        # capacity abort used to render identically in the artifact
+        cause = (f"frontier overflow (engine="
+                 f"{info.get('engine', '?')}, capacity="
+                 f"{info.get('frontier_capacity', '?')})")
         return Analysis(valid=UNKNOWN, op_index=fail_at,
-                        info={**info, "cause": "frontier overflow"})
+                        info={**info, "cause": cause})
     # invalid: bounded counterexample reconstruction (the final-paths
     # role, linear.clj:180-212) — device re-scan to the failing chunk,
     # host replay of at most one chunk from the boundary carry, then
@@ -322,10 +457,15 @@ def _device_verdict(mm, packed, segs, status, fail_seg, n_final,
         from . import counterexample as CE
         # F >= the verdict's capacity: a larger frontier can't change
         # an INVALID verdict (overflow would have been UNKNOWN), and
-        # the 256 floor shares compiles with the capacity ladder
+        # the 256 floor shares compiles with the capacity ladder. The
+        # re-scan runs the XLA chunk engine, whose ladder tops out at
+        # 65536 — an MXU verdict from the 131072 rung clamps down
+        # rather than compiling a frontier width the XLA engine never
+        # otherwise sees (compile time scales with F; a re-scan
+        # overflow at the clamp degrades to an undecorated INVALID)
         ce = CE.reconstruct(mm, packed,
-                            F=max(256, info.get("frontier_capacity",
-                                                256)))
+                            F=max(256, min(info.get(
+                                "frontier_capacity", 256), 65536)))
         if ce is not None:
             cfgs = ce.configs
             op_index = ce.op_index

@@ -235,5 +235,134 @@ def register_batch_packed(seed: int, n_histories: int, n_ops: int,
         p_info=p_info))
 
 
+# --- genuinely-concurrent wide-P histories (MXU engine load) ---------------
+#
+# ``pinned_wide_history`` (ops/synth.py) exercises wide-P PackPlan
+# coverage with crashed cas holding slots — it deliberately forks NO
+# configs, so it can't exercise a wide-frontier engine. These waves
+# do: every op of a wave is in flight at once (in-flight depth = P at
+# the wave's first ok, and remap_slots reports P_eff = P), while the
+# frontier stays CONTROLLED instead of the 2^P blow-up of unbounded
+# concurrency:
+#
+# - ``n_chain`` cas ops form a strict chain (cas(v -> v+1 mod M)):
+#   only one linearization order is consistent, so they contribute
+#   chain-prefix configs, not subsets;
+# - ``n_free`` reads all observe the chain's END value: each is
+#   linearizable only once the chain completes, and then any SUBSET of
+#   them may have linearized — 2^n_free configs.
+#
+# Peak frontier ~ n_chain + 2^n_free, tunable independently of P =
+# n_chain + n_free. n_free = 16 with P = 24 exceeds the XLA ladder's
+# 65536 cap (the honest-UNKNOWN threshold this engine raises) while
+# fitting the MXU ladder's 131072; tier-1 tests use small n_free.
+#
+# Linearizable by construction: op k of the serial schedule applies at
+# position k (chain ops first, then the reads), every op's
+# invoke..completion window spans its whole wave, and each process
+# runs exactly one op per wave (single-threaded: wave event blocks are
+# disjoint in time). The seeded-violation twin makes ONE read of the
+# last wave observe (end+1) mod M — a value the register never holds
+# inside that wave's window (windows span n_chain+1 < M values), so
+# the frontier dies exactly at that read's ok.
+
+def wide_register_batch_columns(seed: int, n_histories: int,
+                                n_waves: int, n_chain: int,
+                                n_free: int, values: int = 16,
+                                violation: bool = False
+                                ) -> RegisterBatchColumns:
+    """Columns for genuinely-concurrent bounded-in-flight register
+    histories at P = ``n_chain + n_free`` (see the block comment)."""
+    B = n_histories
+    P = n_chain + n_free
+    M = values
+    if B <= 0 or n_waves <= 0 or n_chain < 1 or n_free < 0:
+        raise ValueError("need n_histories/n_waves >= 1, n_chain >= 1")
+    if n_chain + 1 >= M:
+        raise ValueError(
+            f"need values > n_chain + 1 (got {M} <= {n_chain + 1}): "
+            "a wave window may not wrap the whole value alphabet, or "
+            "the seeded violation value could be legitimately "
+            "observable")
+    if violation and n_free < 1:
+        raise ValueError(
+            "violation=True needs n_free >= 1: the seeded violation "
+            "is a free READ observing a value outside the wave's "
+            "reachable window — with no free reads the twin would "
+            "silently be a valid history")
+    rng = np.random.default_rng(seed)
+    m = 2 * n_waves * P                      # events per history
+    ev_type = np.empty((B, m), np.int8)
+    ev_pid = np.empty((B, m), np.int64)
+    ev_f = np.empty((B, m), np.int8)
+    ev_vk = np.empty((B, m), np.int64)
+    pair = np.full((B, m), -1, np.int32)
+    brow = np.arange(B)
+
+    cur = rng.integers(0, M, B)              # per-history start value
+    for j in range(n_waves):
+        # per-history op schedule for this wave, in SERIAL order:
+        # chain ops 0..n_chain-1 then reads. Wave 0's chain starts
+        # with a write (the register boots nil — a cas can't fire).
+        f = np.empty((B, P), np.int8)
+        vk = np.empty((B, P), np.int64)
+        if j == 0:
+            f[:, 0] = 1                      # write(cur)
+            vk[:, 0] = 1 + cur
+        else:
+            f[:, 0] = 2                      # cas(cur -> cur+1)
+            vk[:, 0] = 1 + M + cur * M + ((cur + 1) % M)
+            cur = (cur + 1) % M
+        for i in range(1, n_chain):
+            f[:, i] = 2
+            vk[:, i] = 1 + M + cur * M + ((cur + 1) % M)
+            cur = (cur + 1) % M
+        f[:, n_chain:] = 0                   # reads of the end value
+        vk[:, n_chain:] = (1 + cur)[:, None]
+        if violation and j == n_waves - 1 and n_free > 0:
+            # the twin: one read observes a value outside the wave's
+            # reachable window
+            vk[:, P - 1] = 1 + ((cur + 1) % M)
+        # each process runs exactly one wave op; which op lands on
+        # which process is shuffled per history
+        perm = np.argsort(rng.random((B, P)), axis=1)
+        # event order inside the wave: all P invokes (shuffled), then
+        # all P completions (shuffled; the violating read completes
+        # LAST so the frontier still peaks before it dies). argsort of
+        # uniform noise is a uniform permutation — its rows ARE the
+        # event positions of ops 0..P-1.
+        ok_order = rng.random((B, P))
+        if violation and j == n_waves - 1 and n_free > 0:
+            ok_order[:, P - 1] = 2.0         # sorts last
+        ok_rank = np.argsort(np.argsort(ok_order, axis=1), axis=1)
+        base = 2 * P * j
+        inv_pos = base + np.argsort(rng.random((B, P)), axis=1)
+        ok_pos = base + P + ok_rank
+        for col, pos in ((inv_pos, True), (ok_pos, False)):
+            idx = (brow[:, None], col)
+            ev_type[idx] = INVOKE if pos else OK
+            ev_pid[idx] = perm
+            ev_f[idx] = f
+            ev_vk[idx] = vk
+        pair[brow[:, None], inv_pos] = ok_pos
+        pair[brow[:, None], ok_pos] = inv_pos
+    fails = np.zeros((B, m), bool)
+    return RegisterBatchColumns(ev_type, ev_pid, ev_f, ev_vk, fails,
+                                pair, M)
+
+
+def wide_register_batch_packed(seed: int, n_histories: int,
+                               n_waves: int, n_chain: int,
+                               n_free: int, values: int = 16,
+                               violation: bool = False
+                               ) -> List[PackedHistory]:
+    """One-call columnar generate + pack of the wide-P wave histories
+    (see :func:`wide_register_batch_columns`)."""
+    return pack_register_columns(wide_register_batch_columns(
+        seed, n_histories, n_waves, n_chain, n_free, values=values,
+        violation=violation))
+
+
 __all__ = ["RegisterBatchColumns", "register_batch_columns",
-           "pack_register_columns", "register_batch_packed"]
+           "pack_register_columns", "register_batch_packed",
+           "wide_register_batch_columns", "wide_register_batch_packed"]

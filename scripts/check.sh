@@ -205,6 +205,32 @@ if [ "$RC_MIN" -ne 1 ]; then
 fi
 rm -rf "$SHRINK_STORE"
 
+stage mxu-smoke "MXU frontier engine smoke (wide-P valid + violation)"
+# the round-10 engine end to end through the driver ladder: a
+# genuinely concurrent P=16 bounded-in-flight history must come back
+# VALID and its seeded-violation twin INVALID, BOTH attributed to the
+# mxu-frontier engine (wide P is exactly the shape every other engine
+# either rejects or answers UNKNOWN on)
+run env JAX_PLATFORMS=cpu python - <<'EOF'
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from comdb2_tpu.checker import analysis
+from comdb2_tpu.models.model import cas_register
+from comdb2_tpu.ops import synth_columnar as SC
+
+for violation, want in ((False, True), (True, False)):
+    h = SC.wide_register_batch_packed(
+        101, 1, n_waves=2, n_chain=13, n_free=3, values=16,
+        violation=violation)[0]
+    a = analysis(cas_register(), h, backend="device",
+                 host_threshold=1)
+    assert a.valid is want, (violation, a.valid, a.info)
+    assert a.info.get("engine") == "mxu-frontier", a.info
+print("mxu smoke: wide-P valid VALID, seeded violation INVALID, "
+      "engine=mxu-frontier")
+EOF
+
 stage multichip "multichip dryrun (8-device CPU mesh, interpret kernel)"
 # the full sharded checking step on the forced 8-device CPU mesh:
 # shard_map stream path (fused kernel in interpret mode), kernel/XLA
@@ -423,6 +449,7 @@ if [ "$JSON_MODE" = 0 ]; then
     echo "OK: checker clean, ASan build clean, native static" \
          "analysis clean, ct_pmux shutdown clean, txn smoke caught" \
          "the seeded cycle, shrink smoke reached the known minimum," \
+         "mxu smoke answered both wide-P fixtures," \
          "multichip dryrun bit-identical across the mesh," \
          "verifier service shutdown clean, two-daemon pmux routing" \
          "served on both shards, obs smoke traced a check+shrink" \

@@ -134,6 +134,24 @@ def main() -> None:
     bench("flat B=1", lambda: LJ.check_device_flat(
         succ, ip, it, op, segs.depth, B=1, F=F, P=P, **sizes)[0], lane0)
 
+    # the MXU frontier engine row: owns P >= 16 in the driver ladder
+    # (scripts/bench_mxu.py sweeps the crossover); timed at the bench
+    # shape so its narrow-P overhead is ON RECORD next to the engines
+    # that serve narrow P — its matmul step is P-independent, the win
+    # arrives with width (docs/architecture.md "The engine ladder")
+    from comdb2_tpu.checker import mxu as MXU
+
+    if MXU.fits(sizes["n_states"], sizes["n_transitions"], P):
+        # F rides the engine's declared CAPACITIES rungs — the
+        # bench's shared F would compile an off-inventory program
+        F_mxu = MXU.bucket_F(F)
+        bench("mxu B=1", lambda: MXU.check_device_mxu(
+            succ, segs.inv_proc, segs.inv_tr, segs.ok_proc,
+            segs.depth, F=F_mxu, P=P, **sizes)[0], single)
+    else:
+        print("mxu                     outside the table caps for "
+              "this shape", flush=True)
+
     # the production path: the fused Pallas kernel on slot-renamed
     # segments, at the driver's exact tier choice (even-bucket only
     # while the (8,128) tier serves it — linear._analyze_device)

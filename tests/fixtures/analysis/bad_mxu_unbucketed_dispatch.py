@@ -1,0 +1,28 @@
+"""Seeded violation: raw (unbucketed) shapes reach the MXU frontier
+engine's batch jit boundary — the new ``check_device_mxu_batch``
+dispatch sink of the ``unbucketed-dispatch-site`` rule. The raw
+``memo.n_states`` is laundered through a helper so only the
+interprocedural chase can tie the call site to the engine entry's
+static shape argument; one compiled program per distinct wide-P
+history shape, recompiles can OOM LLVM."""
+
+from comdb2_tpu.checker import mxu as MXU
+
+
+def _dispatch_mxu(succ, sb, n_states, n_transitions):
+    # the sink: the MXU batch entry's static table dims come from the
+    # caller's parameters
+    return MXU.check_device_mxu_batch(
+        succ, sb.inv_proc, sb.inv_tr, sb.ok_proc, sb.depth,
+        B=8, F=1024, P=16, n_states=n_states,
+        n_transitions=n_transitions)
+
+
+def check_all(batches):
+    out = []
+    for memo, sb in batches:
+        # BUG: raw memo counts, no next_pow2 — every distinct wide-P
+        # history shape compiles a fresh program
+        out.append(_dispatch_mxu(memo.succ, sb, memo.n_states,
+                                 memo.n_transitions))
+    return out

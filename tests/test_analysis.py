@@ -42,6 +42,7 @@ FIXTURE_RULES = {
     "bad_pallas_k9.py": "pallas-k-cap",
     "bad_unbucketed_shape.py": "jaxpr-unbucketed-shape",
     "bad_unbucketed_dispatch.py": "unbucketed-dispatch-site",
+    "bad_mxu_unbucketed_dispatch.py": "unbucketed-dispatch-site",
     "bad_unsharded_mesh_dispatch.py": "unbucketed-dispatch-site",
     "bad_vmap_sharded_route.py": "vmap-sharded-oracle",
     "bad_stale_suppression.py": "stale-suppression",
@@ -95,6 +96,20 @@ def test_fixture_trips_rule(fixture, rule):
 def test_fixtures_excluded_from_repo_scan():
     files = analysis.collect_files()
     assert files and not any("fixtures" in f for f in files)
+
+
+def test_hash_dedup_rule_covers_mxu_module():
+    """checker/mxu.py imports jax, so the hash-dedup rule is ACTIVE
+    there: a hash() snuck into the new engine's dedup path would be a
+    finding (the rule keys on the jax import, not a module list — this
+    pins that the new engine didn't fall outside it), and the module
+    as committed is clean."""
+    path = os.path.join(REPO, "comdb2_tpu", "checker", "mxu.py")
+    with open(path) as fh:
+        src = fh.read()
+    seeded = lint.lint_file(path, source=src + "\n_bad = hash((1, 2))\n")
+    assert any(f.rule == "hash-dedup" for f in seeded)
+    assert [f.format() for f in lint.lint_file(path, source=src)] == []
 
 
 # --- budget analyzer golden tests --------------------------------------------
