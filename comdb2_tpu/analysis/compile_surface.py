@@ -194,6 +194,7 @@ def _ladders() -> dict:
     from ..service.sharding import MAX_SHARDS
     from ..shrink.verdicts import MAX_BATCH, MIN_BUCKET
     from ..txn.edges import TXN_N_FLOOR
+    from ..stream import engine as stream_engine
     from ..utils import next_pow2
     from .pallas_budget import PRODUCTION_BUCKETS
     from .pallas_budget import production_tiers
@@ -238,6 +239,11 @@ def _ladders() -> dict:
         "mxu_chunk": (64, mxu.CHUNK),
         "mxu_P": (mxu.MIN_P, mxu.MAX_P),
         "mxu_words": tuple(mxu_words),
+        "stream_delta": tuple(stream_engine.DELTA_PADS),
+        "stream_F": tuple(stream_engine.STREAM_CAPACITIES),
+        # session slot widths: even-bucketed like the driver, capped
+        # by the MXU crossover ceiling (wider P has no engine)
+        "stream_P": tuple(range(2, mxu.MAX_P + 1, 2)),
     }
 
 
@@ -341,6 +347,33 @@ def static_inventory() -> Inventory:
             + ((mxu_F,),) * W
             + ((mxu_F,), (one,), (one,), (one,)))
 
+    # stream-delta site (docs/streaming.md): the session append's
+    # delta tensors + the resident carry. The carry's (F,) / (F, P)
+    # planes ride the STREAM_CAPACITIES x even-P ladders; scalars
+    # (seg_offset, count, status, fail) are shape ()
+    stream_delta_ax = Axis("delta_pad", "enum",
+                           values=L["stream_delta"])
+    stream_K = Axis("stream_K", "pow2", 1, L["mxu_P"][1])
+    stream_F_ax = Axis("stream_F", "enum", values=L["stream_F"])
+    stream_P_ax = Axis("stream_P", "enum", values=L["stream_P"])
+    stream_templates = [
+        ((memo, memo), (stream_delta_ax, stream_K),
+         (stream_delta_ax, stream_K), (stream_delta_ax,),
+         (stream_delta_ax,), (),
+         (stream_F_ax,), (stream_F_ax, stream_P_ax), (stream_F_ax,),
+         (), (), ()),
+    ]
+    # the kernel rung's chunk call: one spec chunk + offsets + the
+    # (ws, stat, res) carry + packed table — same axes as the
+    # pallas-stream-scan ladder, single-chunk form
+    stream_kernel_templates = []
+    for W in L["kernel_words"]:
+        stream_kernel_templates.append(
+            ((chunk, width), (Axis("off", "enum", values=(2,)),))
+            + ((rows, lane),) * W
+            + ((one, lane), (Axis("res_rows", "enum", values=(8,)),
+                             lane), (table_rows, lane)))
+
     sites = (
         Site(
             key="pallas-stream-scan",
@@ -401,6 +434,28 @@ def static_inventory() -> Inventory:
             + tuple(mxu_chunk_tmpls),
             axes_doc=(mxu_S, mxu_T, S, B, mxu_K, mxu_F, mxu_chunk_ax,
                       mxu_words_ax),
+        ),
+        Site(
+            key="stream-delta",
+            jit_names=("stream_delta_chunk", "stream_kernel_delta"),
+            note="streaming-session delta dispatch (stream/engine): "
+                 "the ONE device entry an append reaches. "
+                 "`stream_delta_chunk` is the XLA rung — delta "
+                 "segment tensors on the DELTA_PADS pow2 ladder, K "
+                 "pow2 up to the MXU P ceiling, the resident carry "
+                 "(states/slots/valid + scalars) at a "
+                 "STREAM_CAPACITIES frontier rung and an even-"
+                 "bucketed slot width; memo dims pow2 (pad_sizes). "
+                 "`stream_kernel_delta` is the kernel rung's chunk "
+                 "call (same Mosaic program family as "
+                 "pallas-stream-scan, re-jitted under a declared "
+                 "serving name). The MXU rung rides the mxu-frontier "
+                 "site's chunk form with delta pads floored to its "
+                 "chunk ladder (MXU_DELTA_FLOOR)",
+            templates=tuple(stream_templates)
+            + tuple(stream_kernel_templates),
+            axes_doc=(stream_delta_ax, stream_K, stream_F_ax,
+                      stream_P_ax, memo),
         ),
         Site(
             key="xla-batch-vmap",
@@ -515,6 +570,17 @@ def _witness_specs():
         return jax.eval_shape(fn, st((32, 32)), st((8, 2, 2)),
                               st((8, 2, 2)), st((8, 2)), st((8,)))
 
+    def stream_delta_witness():
+        from ..stream import engine as SE
+
+        fn = functools.partial(SE.stream_delta_chunk, F=256, Fs=32,
+                               P=2, n_states=16, n_transitions=16)
+        carry = (st((256,)), st((256, 2)), st((256,), np.bool_),
+                 st(()), st(()), st(()))
+        return jax.eval_shape(fn, st((16, 16)), st((16, 2)),
+                              st((16, 2)), st((16,)), st((16,)),
+                              st(()), carry)
+
     def _witness_mesh():
         # a 1-device mesh: available on every platform, and the D=1
         # rung keeps the artifact deterministic across environments
@@ -571,6 +637,9 @@ def _witness_specs():
         ("mxu-frontier",
          "check_device_mxu_batch at (32,32) S=8 B=2 K=2 P=16 F=1024",
          mxu_witness),
+        ("stream-delta",
+         "stream_delta_chunk at (16,16) delta=16 K=2 F=256 P=2",
+         stream_delta_witness),
         ("txn-closure", "closure bucket N=16", closure_witness),
         ("txn-closure",
          "closure_diag_kernel_sharded: B=2 N=16, D=1 mesh rung",
@@ -699,6 +768,15 @@ def render_programs() -> str:
         "batch pow2-buckets) |",
         f"| mxu key words | {list(L['mxu_words'])} | "
         "`PackPlan.n_words` over the table caps x P |",
+        f"| stream delta_pad | {list(L['stream_delta'])} | "
+        "`stream.engine.DELTA_PADS` (session appends bucket onto it; "
+        "larger deltas split; MXU rung floors at MXU_DELTA_FLOOR) |",
+        f"| stream frontier F | {list(L['stream_F'])} | "
+        "`stream.engine.STREAM_CAPACITIES` (in-place "
+        "expand_seg_carry escalation rungs) |",
+        f"| stream P | even {L['stream_P'][0]}..{L['stream_P'][-1]} |"
+        " session slot width (renamed concurrency, even-bucketed; "
+        "in-place expand_seg_carry_slots widening) |",
         "",
         "## Dispatch sites",
         "",
@@ -776,6 +854,10 @@ SHAPE_SINKS: Dict[str, dict] = {
     "stream_dispatch_sharded": {"kwargs": ("n_states",
                                            "n_transitions")},
     "check_sharded": {"kwargs": ("n_states", "n_transitions")},
+    # the streaming-session delta entrypoint: raw memo counts here
+    # would compile one program per live history's alphabet — every
+    # caller must route through stream.engine.pad_sizes
+    "stream_delta_chunk": {"kwargs": ("n_states", "n_transitions")},
 }
 
 #: callables that PRODUCE bucketed values

@@ -91,14 +91,18 @@ PER_ITEM_DISPATCH_NAMES = {"check_device_batch", "check_device",
 #: seeded fixture and future pack helpers are covered)
 PACK_SEGMENT_MODULES = {"packed.py", "columnar.py",
                         "synth_columnar.py", "batch.py",
-                        "linear_jax.py", "pallas_seg.py"}
+                        "linear_jax.py", "pallas_seg.py",
+                        # the streaming delta ingest/segment path is
+                        # columnar by the same contract (the session
+                        # pays the pass PER APPEND, forever)
+                        "ingest.py", "segment.py"}
 
 #: the dispatch-pipeline scope of ``raw-clock-in-pipeline``: package
 #: directories plus the checker dispatch modules (files whose
 #: basename contains "dispatch" are included so the seeded fixture
 #: and future dispatch helpers are covered); ``obs`` is the clock's
 #: home and exempt
-RAW_CLOCK_DIRS = {"service", "shrink", "txn"}
+RAW_CLOCK_DIRS = {"service", "shrink", "txn", "stream"}
 RAW_CLOCK_FILES = {"linear.py", "batch.py", "pallas_seg.py"}
 RAW_CLOCK_FNS = {"time", "monotonic", "perf_counter"}
 

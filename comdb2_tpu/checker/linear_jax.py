@@ -841,6 +841,30 @@ def expand_seg_carry(carry, F_new: int):
             jnp.int32(-1))
 
 
+def expand_seg_carry_slots(carry, P_new: int):
+    """Widen a carry's SLOT axis in place (streaming sessions whose
+    effective concurrency grows mid-stream): new slots pad IDLE, which
+    leaves every config's semantics unchanged — a relabeled key layout
+    is still exact, and the renamed segment streams only ever address
+    slots below the renamer's running P_eff. Status/fail/count are
+    preserved: this is a mid-stream widening, not a capacity
+    escalation.
+
+    HOST numpy on purpose (like ``mxu.expand_carry``): widenings are
+    rare, and an eager device pad here would compile an infra program
+    outside the declared compile surface per carry shape — the next
+    delta's jit transfers the widened carry instead."""
+    states, slots, valid, count, status, fail = (np.asarray(x)
+                                                 for x in carry)
+    pad = P_new - slots.shape[1]
+    if pad < 0:
+        raise ValueError("carry has more slots than target width")
+    if pad:
+        slots = np.pad(slots, ((0, 0), (0, pad)),
+                       constant_values=IDLE)
+    return (states, slots, valid, count, status, fail)
+
+
 @functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
                                              "n_transitions"))
 def check_device_seg_chunk(succ, inv_proc, inv_tr, ok_proc, depth,

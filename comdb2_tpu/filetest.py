@@ -64,6 +64,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "this run (the same span pipeline service "
                         "requests get — parse/pack/device/finalize "
                         "stage breakdown; docs/observability.md)")
+    p.add_argument("--follow", action="store_true",
+                   help="tail mode (docs/streaming.md): poll the file "
+                        "for appended EDN ops (map-per-line) and feed "
+                        "them through a local StreamSession, printing "
+                        "verdict transitions — the offline twin of "
+                        "the service stream kind. Exits when the "
+                        "verdict latches or the file goes idle for "
+                        "--follow-idle seconds (then the tail settles "
+                        "and the final verdict is one-shot-identical)")
+    p.add_argument("--follow-poll", type=float, default=0.2,
+                   metavar="S", help="tail poll interval (s)")
+    p.add_argument("--follow-idle", type=float, default=5.0,
+                   metavar="S",
+                   help="finalize after this long without new bytes "
+                        "(0 = follow forever)")
     args = p.parse_args(argv)
     if args.txn:
         args.checker = "txn"
@@ -89,6 +104,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 def _run(args) -> int:
     """The checker run proper (main owns arg parsing + the trace
     export, which must happen on EVERY exit path)."""
+    if args.follow:
+        if args.checker not in ("linear",):
+            print("--follow supports the linear checker only",
+                  file=sys.stderr)
+            return 3
+        from .utils.platform import ensure_backend
+
+        ensure_backend()
+        return _run_follow(args)
     if args.service:
         # remote path first: the whole point is NOT to attach this
         # process to a device (the tunnel costs ~100 ms per dispatch;
@@ -239,6 +263,97 @@ def _run(args) -> int:
     if valid is True:
         return 0
     if valid == "unknown":
+        return 2
+    return 1
+
+
+def _run_follow(args) -> int:
+    """Tail mode: incremental byte-offset reads of a map-per-line EDN
+    history, each batch of complete new lines fed as one delta to a
+    local :class:`~comdb2_tpu.stream.StreamSession` (keyed histories
+    re-wrapped PER DELTA — the values carry no type tag; nemesis
+    completions stay type ``info`` and ride through the ingest like
+    any op). Prints a line per verdict TRANSITION plus a progress
+    line per append; the idle timeout settles the tail and exits with
+    the standard verdict code."""
+    import time
+
+    from .obs.trace import monotonic as mono
+    from .stream import StreamSession
+
+    keyed = args.keyed or args.model == "cas-register-comdb2"
+    s = StreamSession(args.model)
+    pos = 0
+    buf = ""
+    last_valid = True
+    last_bytes = mono()
+
+    def transition(out) -> None:
+        nonlocal last_valid
+        if out["valid"] != last_valid:
+            print(f"verdict: {last_valid!r} -> {out['valid']!r} at "
+                  f"op {out['op_index']} "
+                  f"(checked_through={out['checked_through']})",
+                  flush=True)
+            last_valid = out["valid"]
+
+    while True:
+        try:
+            with open(args.history) as fh:
+                fh.seek(pos)
+                chunk = fh.read()
+                pos = fh.tell()
+        except FileNotFoundError:
+            chunk = ""
+        if chunk:
+            buf += chunk
+            lines, _, buf = buf.rpartition("\n")
+            if lines.strip():
+                ops = parse_history(lines)
+                if keyed:
+                    from .checker.independent import \
+                        wrap_keyed_history
+
+                    ops = wrap_keyed_history(ops)
+                out = s.append(ops)
+                print(f"append: +{len(ops)} ops -> valid="
+                      f"{out['valid']!r} checked_through="
+                      f"{out['checked_through']}/{out['op_count']} "
+                      f"engine={out['engine']} "
+                      f"dispatches={out['dispatches']}", flush=True)
+                transition(out)
+                if out["valid"] is not True:
+                    break
+            last_bytes = mono()
+        elif args.follow_idle > 0 and \
+                mono() - last_bytes >= args.follow_idle:
+            break
+        else:
+            time.sleep(max(args.follow_poll, 0.01))
+    if buf.strip() and s.valid is True:
+        # a final line without a trailing newline (writer crashed or
+        # never terminated the file) is still part of the history —
+        # the idle timeout decided the stream ended, so feed it
+        # before the final settle or the one-shot-identical claim
+        # breaks on exactly the histories whose writer died
+        ops = parse_history(buf)
+        if keyed:
+            from .checker.independent import wrap_keyed_history
+
+            ops = wrap_keyed_history(ops)
+        transition(s.append(ops))
+    out = s.finalize_input()
+    transition(out)
+    pprint.pprint({k: out[k] for k in
+                   ("valid", "op_index", "op_count",
+                    "checked_through", "segments", "engine",
+                    "dispatches", "appends", "replays")
+                   if k in out}
+                  | ({"cause": out["cause"]} if "cause" in out
+                     else {}))
+    if out["valid"] is True:
+        return 0
+    if out["valid"] == "unknown":
         return 2
     return 1
 

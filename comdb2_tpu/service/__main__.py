@@ -91,6 +91,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "the production default)")
     p.add_argument("--max-ops", type=int, default=8192)
     p.add_argument("--max-segments", type=int, default=4096)
+    p.add_argument("--max-sessions", type=int, default=64,
+                   help="streaming-session cap (kind:\"stream\" — "
+                        "each session holds a device-resident carry; "
+                        "past the cap, open answers overload with "
+                        "retry_after_ms)")
+    p.add_argument("--session-idle-s", type=float, default=300.0,
+                   help="idle TTL before a streaming session's carry "
+                        "is evicted (clients re-open by replay)")
     p.add_argument("--no-prime", action="store_true",
                    help="skip compile-cache warm-start at boot")
     p.add_argument("--interpret", action="store_true",
@@ -146,7 +154,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_queue=args.max_queue, limits=limits,
         inject_dispatch_latency_s=args.inject_dispatch_latency_ms
         / 1e3, shards=args.shards,
-        fill_window_s=args.fill_ms / 1e3, ring_depth=args.ring)
+        fill_window_s=args.fill_ms / 1e3, ring_depth=args.ring,
+        max_sessions=args.max_sessions,
+        session_idle_s=args.session_idle_s)
     pmux_service = args.pmux_service
     if args.pmux_shard is not None:
         pmux_service = f"{PMUX_SERVICE}/{args.pmux_shard}"

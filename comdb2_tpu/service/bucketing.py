@@ -119,6 +119,21 @@ def bucket_for(packed: PackedHistory,
                   P_eff=pe)
 
 
+class StreamBucket(NamedTuple):
+    """One stream-session compiled-shape class: the slot key
+    ``kind:"stream"`` appends coalesce under. ``cls`` is the
+    session's :attr:`~comdb2_tpu.stream.session.StreamSession.
+    shape_class` — rung, slot width, K bucket, table buckets — so
+    same-shape sessions form batches together and share the
+    ``stream-delta`` programs (PROGRAMS.md)."""
+
+    cls: str
+
+    @property
+    def key(self) -> str:
+        return self.cls
+
+
 class TxnBucket(NamedTuple):
     """One compiled-shape class of the txn closure engine: the only
     jit-visible axis is the padded txn count N (pow2, floor
@@ -144,5 +159,5 @@ def txn_bucket_for(n_txns: int,
     return TxnBucket(N=_next_pow2(max(n_txns, 1), TXN_N_FLOOR))
 
 
-__all__ = ["Bucket", "ServiceLimits", "TxnBucket", "bucket_for",
-           "txn_bucket_for"]
+__all__ = ["Bucket", "ServiceLimits", "StreamBucket", "TxnBucket",
+           "bucket_for", "txn_bucket_for"]

@@ -215,6 +215,75 @@ class ServiceClient:
                                reply.get("message", ""))
         return reply
 
+    # -- streaming sessions (kind:"stream", docs/streaming.md) ---------
+
+    def stream_open(self, *, model: Optional[str] = None,
+                    keyed: bool = False, rung: Optional[str] = None,
+                    raise_on_error: bool = True) -> dict:
+        """Open a streaming session; the reply carries ``session``
+        (the id every later verb names). An ``overload`` reply means
+        the daemon's session table is at cap — back off on its
+        ``retry_after_ms`` like any other overload."""
+        self._seq += 1
+        req: dict = {"op": "check", "id": self._seq,
+                     "kind": "stream", "verb": "open"}
+        if model is not None:
+            req["model"] = model
+        if keyed:
+            req["keyed"] = True
+        if rung is not None:
+            req["rung"] = rung
+        reply = self._request_shedding(req)
+        if raise_on_error and not reply.get("ok"):
+            raise ServiceError(reply.get("error", "unknown-error"),
+                               reply.get("message", ""))
+        return reply
+
+    def stream_append(self, session: str,
+                      history: Union[str, List, None], *,
+                      deadline_ms: Optional[int] = None,
+                      raise_on_error: bool = True) -> dict:
+        """Append one op delta; the reply is the verdict-so-far
+        (``valid`` tri-state, ``checked_through``, per-append
+        ``stages``). Once a session latches INVALID/UNKNOWN, appends
+        answer immediately with ``latched: true``."""
+        history = _as_edn(history)
+        self._seq += 1
+        req: dict = {"op": "check", "id": self._seq,
+                     "kind": "stream", "verb": "append",
+                     "session": session, "history": history}
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+        reply = self._request_shedding(req)
+        if raise_on_error and not reply.get("ok"):
+            raise ServiceError(reply.get("error", "unknown-error"),
+                               reply.get("message", ""))
+        return reply
+
+    def stream_poll(self, session: str,
+                    raise_on_error: bool = True) -> dict:
+        self._seq += 1
+        reply = self._request({"op": "check", "id": self._seq,
+                               "kind": "stream", "verb": "poll",
+                               "session": session})
+        if raise_on_error and not reply.get("ok"):
+            raise ServiceError(reply.get("error", "unknown-error"),
+                               reply.get("message", ""))
+        return reply
+
+    def stream_close(self, session: str,
+                     raise_on_error: bool = True) -> dict:
+        """Close: the tail settles (final verdict — bit-identical to
+        a one-shot check of everything appended) and the carry frees."""
+        self._seq += 1
+        reply = self._request({"op": "check", "id": self._seq,
+                               "kind": "stream", "verb": "close",
+                               "session": session})
+        if raise_on_error and not reply.get("ok"):
+            raise ServiceError(reply.get("error", "unknown-error"),
+                               reply.get("message", ""))
+        return reply
+
     def status(self) -> dict:
         return self._request({"op": "status"})
 
@@ -372,6 +441,19 @@ class RoutedClient:
                              route)
         return self._route(key, lambda c: c.shrink(history, **kw))
 
+    def stream_open(self, *, model: Optional[str] = None,
+                    keyed: bool = False,
+                    rung: Optional[str] = None) -> "RoutedStream":
+        """Open a session with AFFINITY: the session id pins every
+        later verb to the daemon holding the carry (routing an append
+        elsewhere would find no session — a carry is not portable
+        over the wire). Failover is replay: when the pinned daemon
+        dies (or evicted the session), the handle re-opens on the
+        next ring node and replays its retained deltas, then resumes
+        — the client-side mirror of the daemon's retained columnar
+        tables (docs/streaming.md "Failover")."""
+        return RoutedStream(self, model=model, keyed=keyed, rung=rung)
+
     def statuses(self) -> Dict[str, dict]:
         """Per-daemon status (skipping unreachable nodes)."""
         out = {}
@@ -396,6 +478,108 @@ class RoutedClient:
         self.close()
 
 
+class RoutedStream:
+    """One streaming session pinned to its daemon (see
+    :meth:`RoutedClient.stream_open`). Retains every appended delta's
+    EDN so a node failure (or idle eviction) re-opens on the next
+    ring node and REPLAYS — the final verdict is unchanged because a
+    session's verdict is a pure function of the concatenated ops."""
+
+    def __init__(self, routed: RoutedClient,
+                 model: Optional[str] = None, keyed: bool = False,
+                 rung: Optional[str] = None):
+        self.routed = routed
+        self.model = model
+        self.keyed = keyed
+        self.rung = rung
+        self._deltas: List[str] = []
+        self.failovers = 0
+        self.node: Optional[str] = None
+        self.session: Optional[str] = None
+        self._open_somewhere(
+            routed.ring.nodes_for(f"stream|{model or ''}|"
+                                  f"{id(self):x}"))
+
+    def _open_somewhere(self, chain) -> None:
+        last: Optional[Exception] = None
+        for name in chain:
+            try:
+                r = self.routed.clients[name].stream_open(
+                    model=self.model, keyed=self.keyed,
+                    rung=self.rung)
+                self.node = name
+                self.session = r["session"]
+                self.routed.served[name] = \
+                    self.routed.served.get(name, 0) + 1
+                return
+            except (OSError, ServiceError) as e:
+                last = e
+        raise OSError(f"no daemon would open a stream session: {last}")
+
+    def _failover(self) -> None:
+        self.failovers += 1
+        self.routed.failovers += 1
+        chain = [n for n in self.routed.ring.nodes_for(
+            f"stream|{self.model or ''}|{id(self):x}")
+            if n != self.node] or list(self.routed.clients)
+        self._open_somewhere(chain)
+        # replay the retained deltas ONE BY ONE in order: each delta
+        # is a self-contained EDN document (vector-of-maps deltas
+        # would mis-parse if concatenated into one text), and each
+        # replay append is O(delta) anyway. A replay failure must
+        # surface — continuing would silently verify a history with
+        # the retained prefix missing.
+        for d in self._deltas:
+            r = self.routed.clients[self.node].stream_append(
+                self.session, d, raise_on_error=False)
+            if not r.get("ok"):
+                raise OSError(
+                    f"failover replay failed on {self.node}: {r}")
+
+    def _pinned(self, fn, retried: bool = False):
+        try:
+            return fn(self.routed.clients[self.node])
+        except OSError:
+            if retried:
+                raise
+            self._failover()
+            return self._pinned(fn, retried=True)
+
+    def append(self, history: Union[str, List], **kw) -> dict:
+        text = _as_edn(history)
+        out = self._pinned(
+            lambda c: c.stream_append(self.session, text,
+                                      raise_on_error=False, **kw))
+        if (not out.get("ok")
+                and out.get("error") == protocol.BAD_REQUEST
+                and "unknown session" in out.get("message", "")):
+            # idle-evicted on a live daemon: same replay path as a
+            # dead node
+            self._failover()
+            out = self._pinned(
+                lambda c: c.stream_append(self.session, text,
+                                          raise_on_error=False, **kw))
+        if out.get("ok") and out.get("cause") != "deadline":
+            # a deadline expiry answers ok with cause="deadline" and
+            # the delta was NEVER ingested (core._expire_one) — it
+            # must not join the replay record as applied; the caller
+            # sees the cause and may re-append the same delta
+            self._deltas.append(text)
+        return out
+
+    def poll(self) -> dict:
+        return self._pinned(
+            lambda c: c.stream_poll(self.session,
+                                    raise_on_error=False))
+
+    def close(self) -> dict:
+        out = self._pinned(
+            lambda c: c.stream_close(self.session,
+                                     raise_on_error=False))
+        self._deltas = []
+        return out
+
+
 def _as_edn(history) -> str:
     if isinstance(history, str):
         return history
@@ -404,5 +588,5 @@ def _as_edn(history) -> str:
     return history_to_edn(list(history or []))
 
 
-__all__ = ["HashRing", "RoutedClient", "ServiceClient",
-           "ServiceError"]
+__all__ = ["HashRing", "RoutedClient", "RoutedStream",
+           "ServiceClient", "ServiceError"]

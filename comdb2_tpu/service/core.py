@@ -49,8 +49,8 @@ from ..obs import trace as obs
 from ..obs.metrics import Registry
 from ..utils import next_pow2 as _next_pow2
 from . import protocol
-from .bucketing import (Bucket, ServiceLimits, TxnBucket, bucket_for,
-                        txn_bucket_for)
+from .bucketing import (Bucket, ServiceLimits, StreamBucket,
+                        TxnBucket, bucket_for, txn_bucket_for)
 
 #: the per-request stage names (docs/observability.md): they TILE the
 #: measured wall per request — queue_wait (admission -> dispatch
@@ -160,7 +160,9 @@ class VerifierCore:
                  inject_dispatch_latency_s: float = 0.0,
                  shards: int = 1,
                  fill_window_s: float = DEFAULT_FILL_WINDOW_S,
-                 ring_depth: int = DEFAULT_RING_DEPTH):
+                 ring_depth: int = DEFAULT_RING_DEPTH,
+                 max_sessions: int = 64,
+                 session_idle_s: float = 300.0):
         from ..models.model import MODELS
 
         if model not in MODELS:
@@ -205,6 +207,14 @@ class VerifierCore:
         # real link does. Always reported in status() so benched
         # numbers can't masquerade as raw.
         self.inject_dispatch_latency_s = inject_dispatch_latency_s
+        # streaming sessions (kind:"stream", docs/streaming.md): one
+        # device-resident carry per monitored live history; the table
+        # is capped (a carry is real device memory) and idle sessions
+        # evict on the pump beat
+        from ..stream.manager import SessionManager
+
+        self.sessions = SessionManager(max_sessions=max_sessions,
+                                       idle_s=session_idle_s)
         self.t_boot = obs.monotonic()
         # continuous-batching admission state
         self._slots: Dict[tuple, _Slot] = {}
@@ -256,7 +266,17 @@ class VerifierCore:
             # request's launch budget expired), idle (wire went
             # quiet — the serial-caller path)
             "launch_full": 0, "launch_deadline": 0, "launch_idle": 0,
+            # streaming sessions: opens/appends/closes + idle
+            # evictions (docs/streaming.md)
+            "stream_opens": 0, "stream_appends": 0,
+            "stream_closes": 0, "stream_evicted": 0,
         }
+        self._g_sessions = self.metrics.gauge(
+            "stream_sessions_active",
+            help="streaming sessions holding a device-resident carry")
+        self._g_carry_bytes = self.metrics.gauge(
+            "stream_carry_resident_bytes",
+            help="device bytes held by resident session carries")
 
     # -- admission queue views -----------------------------------------
 
@@ -387,6 +407,8 @@ class VerifierCore:
             return self._submit_txn(req, now, ctx, rid)
         if kind == "shrink":
             return self._submit_shrink(req, now, ctx, rid)
+        if kind == "stream":
+            return self._submit_stream(req, now, ctx, rid)
         if kind != "check":
             self.m["bad_requests"] += 1
             return None, protocol.error_reply(
@@ -663,6 +685,190 @@ class VerifierCore:
             out["cycle_len"] = len(cex["cycle"])
         return out
 
+    # -- stream-kind admission -----------------------------------------
+
+    def _submit_stream(self, req: dict, now: float, ctx: object, rid):
+        """Admit one streaming-session verb (docs/streaming.md).
+        ``open``/``poll``/``close`` answer immediately (no device
+        dispatch is staged for them — close's final tail flush is the
+        one bounded exception); ``append`` slots into the session's
+        SHAPE-CLASS batch and rides the same launch policy, deadline
+        expiry, and in-flight ring as every other kind."""
+        from ..stream.manager import SessionLimit
+
+        verb = req.get("verb", "append")
+        if verb == "open":
+            model = req.get("model") or self.model
+            from ..models.model import MODELS
+
+            if model not in MODELS:
+                self.m["bad_requests"] += 1
+                return None, protocol.error_reply(
+                    protocol.BAD_REQUEST, f"unknown model {model!r}",
+                    rid)
+            try:
+                sid, s = self.sessions.open(
+                    now, model=model,
+                    engine=req.get("rung", "auto"),
+                    max_states=self.max_host_configs)
+            except SessionLimit as e:
+                # a carry is device memory: the cap sheds exactly like
+                # the admission queue, hint included
+                self.m["overloads"] += 1
+                self._event("overload", now)
+                ra = self._retry_after_ms(now)
+                out = protocol.error_reply(
+                    protocol.OVERLOAD, f"{e}; retry in ~{ra} ms", rid)
+                out["retry_after_ms"] = ra
+                return None, out
+            s.keyed = (bool(req.get("keyed"))
+                       or model == "cas-register-comdb2")
+            self.m["stream_opens"] += 1
+            return None, self._reply(rid, True, kind="stream",
+                                     session=sid, model=model)
+        sid = req.get("session")
+        s = self.sessions.get(sid, now)
+        if s is None:
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"unknown session {sid!r} (expired or never opened — "
+                "re-open and replay)", rid)
+        if verb == "poll":
+            return None, self._stream_reply(rid, sid, s.poll())
+        if verb == "close":
+            out = self.sessions.close(sid)
+            self.m["stream_closes"] += 1
+            return None, self._stream_reply(rid, sid, out)
+        if verb != "append":
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unknown stream verb {verb!r}",
+                rid)
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, "missing history (EDN delta)",
+                rid)
+        try:
+            ops = self._parse(text, s.model_name,
+                              keyed=getattr(s, "keyed", False))
+        except Exception as e:              # noqa: BLE001 — client data
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unparseable delta: {e}", rid)
+        self.m["accepted"] += 1
+        self.m["stream_appends"] += 1
+        if s.valid is not True:
+            # the latch: answer without queueing a dispatch
+            self.m["completed"] += 1
+            out = self._stream_reply(rid, sid, s.poll())
+            out["latched"] = True
+            return None, out
+        dl = req.get("deadline_ms")
+        if dl is not None and not isinstance(dl, (int, float)):
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"deadline_ms must be a number, got {type(dl).__name__}",
+                rid)
+        pending = PendingRequest(
+            rid=rid, model=s.model_name, packed=(sid, s, ops),
+            bucket=StreamBucket(s.shape_class), t_in=now, ctx=ctx,
+            kind="stream",
+            t_dead=(now + float(dl) / 1e3) if dl is not None else None)
+        self._bstats(pending.bucket.key).requests += 1
+        self._slot_add(pending, now)
+        return pending, None
+
+    def _stream_reply(self, rid, sid, verdict: dict) -> dict:
+        out = self._reply(rid, verdict.get("valid"), kind="stream",
+                          session=sid)
+        for k, v in verdict.items():
+            out.setdefault(k, v)
+        return out
+
+    def _dispatch_stream_begin(self, bucket: StreamBucket,
+                               items: List[PendingRequest]):
+        """Stage one shape-class batch of session appends: each
+        session ingests its delta and dispatches ONLY the new
+        segments against its resident carry (async), so the staging
+        pass overlaps all the deltas' device runs; ``finish`` reads
+        the verdicts back oldest-first. Same ring contract as
+        :meth:`_dispatch_begin`; same-shape sessions share the
+        ``stream-delta`` programs so the batch amortizes compiles
+        even though each carry is its own dispatch."""
+        from ..stream import engine as _SE
+
+        t0 = obs.monotonic()
+        rids = [p.rid for p in items]
+        for p in items:
+            p.stages["queue_wait_ms"] = (t0 - p.t_in) * 1e3
+        fins = []
+        d0 = _SE.DISPATCHES
+        with obs.span("stage", kind="stream", bucket=bucket.key,
+                      b=len(items), rids=rids):
+            for p in items:
+                sid, s, ops = p.packed
+                try:
+                    fins.append(s.append_stage(ops))
+                except Exception as e:          # noqa: BLE001
+                    cause = f"engine: {type(e).__name__}: {e}"
+                    fins.append(("err", cause))
+        t_staged = obs.monotonic()
+        pack_ms = (t_staged - t0) * 1e3
+        for p in items:
+            p.stages["host_pack_ms"] = pack_ms
+
+        def finish(done: list) -> None:
+            t_fin = obs.monotonic()
+            n_disp = _SE.DISPATCHES - d0
+            if self.inject_dispatch_latency_s > 0.0 and n_disp:
+                # the injected tunnel model, per dispatch like every
+                # other kind — remaining-only against stage time
+                remaining = (t_staged
+                             + self.inject_dispatch_latency_s * n_disp
+                             - obs.monotonic())
+                if remaining > 0.0:
+                    time.sleep(remaining)
+            t_done = obs.monotonic()
+            bs = self._bstats(bucket.key)
+            bs.dispatches += n_disp
+            bs.batched += len(items)
+            bs.device_s += (t_staged - t0) + (t_done - t_fin)
+            self.m["dispatches"] += n_disp
+            obs.record("device", t_staged, t_done, bucket=bucket.key,
+                       engine="stream-session", rids=rids)
+            with obs.span("finalize", kind="stream",
+                          bucket=bucket.key, rids=rids):
+                for p, fin in zip(items, fins):
+                    sid, s, _ops = p.packed
+                    if isinstance(fin, tuple):
+                        self.m["engine_errors"] += 1
+                        self._event("engine_error", obs.monotonic())
+                        reply = self._reply(p.rid, "unknown",
+                                            kind="stream",
+                                            session=sid, cause=fin[1])
+                    else:
+                        try:
+                            verdict = fin()
+                        except Exception as e:  # noqa: BLE001
+                            self.m["engine_errors"] += 1
+                            verdict = {
+                                "valid": "unknown",
+                                "cause": f"engine: "
+                                         f"{type(e).__name__}: {e}"}
+                        reply = self._stream_reply(p.rid, sid,
+                                                   verdict)
+                        reply["batched"] = len(items)
+                    p.stages["device_ms"] = (t_done - t_staged) * 1e3
+                    p.stages["finalize_ms"] = \
+                        (obs.monotonic() - t_done) * 1e3
+                    self._finish(p, reply, done)
+
+        return finish
+
     # -- the scheduler beat --------------------------------------------
 
     def pump(self, now: Optional[float] = None, idle: bool = False):
@@ -674,6 +880,12 @@ class VerifierCore:
         window) and the in-flight ring drains fully."""
         now = obs.monotonic() if now is None else now
         self._expire(now)
+        # idle-session eviction on the scheduler beat: a carry nobody
+        # appends to is device memory doing nothing — release it; the
+        # client re-opens by replaying its retained deltas
+        for _sid in self.sessions.evict_idle(now):
+            self.m["stream_evicted"] += 1
+            self._event("stream_evict", now)
         self._g_queue.set(self.queue_depth())
         for key in list(self._slots):
             slot = self._slots[key]
@@ -817,6 +1029,10 @@ class VerifierCore:
                 self._done)
             return
         extra = {"kind": "txn"} if p.kind == "txn" else {}
+        if p.kind == "stream":
+            # the delta was never ingested: the session is unchanged
+            # and the client may retry the same append
+            extra = {"kind": "stream", "session": p.packed[0]}
         self._finish(p, self._reply(p.rid, "unknown",
                                     cause="deadline", **extra),
                      self._done)
@@ -839,6 +1055,8 @@ class VerifierCore:
             chunk = items[i:i + self.batch_cap]
             if kind == "txn":
                 fin = self._dispatch_txn_begin(bucket, chunk)
+            elif kind == "stream":
+                fin = self._dispatch_stream_begin(bucket, chunk)
             else:
                 fin = self._dispatch_begin(model, bucket, chunk)
             self._ring_push(fin)
@@ -1302,6 +1520,8 @@ class VerifierCore:
         m = self.metrics
         self._g_queue.set(self.queue_depth())
         self._g_ring.set(len(self._ring))
+        self._g_sessions.set(len(self.sessions))
+        self._g_carry_bytes.set(self.sessions.carry_bytes())
         for k, v in self.m.items():
             m.counter(f"service_{k}_total").value = v
         for key, bs in self._buckets.items():
@@ -1369,6 +1589,12 @@ class VerifierCore:
             "ring_depth": self.ring_depth,
             "fill_window_ms": round(self.fill_window_s * 1e3, 3),
             "carry_reuses": PS.CARRY_REUSES,
+            "stream": {
+                "sessions": len(self.sessions),
+                "max_sessions": self.sessions.max_sessions,
+                "carry_bytes": self.sessions.carry_bytes(),
+                "idle_s": self.sessions.idle_s,
+            },
             "model": self.model,
             "engine": self.engine,
             "shards": self.shards,

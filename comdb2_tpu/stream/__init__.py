@@ -1,0 +1,20 @@
+"""Streaming verification sessions — device-resident incremental
+checking of live histories (docs/streaming.md).
+
+Every other surface in the repo is post-hoc batch (collect, then
+verify — the Jepsen/knossos shape); this subsystem verifies traffic
+*as it happens*: a long-lived :class:`StreamSession` owns a
+device-resident frontier carry, ``append(ops)`` packs only the delta
+as a columnar slice, segments only the new suffix, and dispatches
+only the new segments against the resident carry — per-append cost is
+O(delta), never O(history). Served as service ``kind:"stream"``
+(:mod:`comdb2_tpu.service`) and offline as ``filetest --follow``.
+"""
+
+from .ingest import MalformedDelta, StreamIngest
+from .manager import SessionLimit, SessionManager
+from .segment import StreamSegmenter
+from .session import StreamSession
+
+__all__ = ["MalformedDelta", "SessionLimit", "SessionManager",
+           "StreamIngest", "StreamSegmenter", "StreamSession"]

@@ -1,0 +1,353 @@
+"""Device-resident carry rungs for streaming sessions.
+
+A session's engine carry lives ON DEVICE between ``append``s — the
+O(1)-per-step carried-state discipline of autoregressive-decode
+caches applied to verification: each delta dispatch consumes only the
+NEW segments against the resident frontier, so per-append device work
+is O(delta), never O(history). Three rungs share one interface:
+
+- **kernel** (``pallas_seg``): the fused Mosaic kernel's (ws, stat)
+  word carry, chunk calls offset into the session's global segment
+  stream. F is fixed at 128; overflow re-routes the session to the
+  next rung by replaying the RETAINED renamed segments (the one
+  O(history) event a session can pay, amortized over its life).
+- **xla** (``stream_delta_chunk`` below — the bucketed, closed-site
+  twin of ``check_device_seg2_chunk``): the (states, slots, valid, …)
+  carry; capacity escalates IN PLACE via ``expand_seg_carry`` (widen
+  the pre-delta carry, re-run only the delta) and the slot axis
+  widens in place via ``expand_seg_carry_slots`` when the live
+  history's concurrency grows. The carry is shape-portable across
+  memo-table bucket growth: state ids are stable
+  (:class:`~comdb2_tpu.models.memo.IncrementalMemo`) and the packed
+  dedup key layout is internal to the program.
+- **mxu** (``checker.mxu``): the packed-word carry for wide-P
+  sessions; ``expand_carry`` escalates in place up to the 131072
+  rung. The word layout bakes in (n_states, n_transitions, P), so
+  table-bucket or P growth re-plans via replay.
+
+Every delta shape rides the ``DELTA_PADS`` pow2 ladder (PROGRAMS.md
+``stream-delta`` site) so the compiled-program set stays closed no
+matter how a live history's appends are sized; deltas larger than the
+top rung split into top-rung chunks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..checker import linear_jax as LJ
+from ..checker import mxu as MXU
+from ..checker import pallas_seg as PSEG
+from ..utils import next_pow2 as _next_pow2
+
+#: padded segments per delta dispatch — the pow2 ladder every append
+#: is bucketed onto (floor 16: tiny appends share one program; top
+#: 1024: larger appends split). The MXU rung floors at its declared
+#: chunk ladder's minimum (64).
+DELTA_PADS = (16, 64, 256, 1024)
+MXU_DELTA_FLOOR = 64
+
+#: the XLA rung's frontier ladder (same rungs as the driver's default
+#: ``analysis(capacities=...)``) — in-place escalation, overflow at
+#: the top is the honest UNKNOWN for P below the MXU crossover
+STREAM_CAPACITIES = (256, 1024, 8192, 65536)
+
+#: small-tier capacity of the adaptive closure (see check_device_seg2)
+STREAM_FS = 32
+
+#: stream delta dispatches this process (all rungs) — the O(delta)
+#: counter tests and benches assert on
+DISPATCHES = 0
+
+#: ladder ceilings (PROGRAMS.md stream-delta axes): a session whose
+#: renamed concurrency or per-segment invoke burst outgrows them has
+#: no declared program to run — it latches UNKNOWN (the one-shot
+#: path's analog is bucket_for's host-degrade rejection; crash-heavy
+#: histories pin :info slots forever and CAN get here)
+STREAM_MAX_P = MXU.MAX_P
+STREAM_MAX_K = 32
+
+
+def bucket_delta(n_segments: int, floor: int = 0) -> int:
+    """The delta_pad rung for one append's segment count (top rung
+    when it exceeds the ladder — the caller then splits)."""
+    for p in DELTA_PADS:
+        if p >= max(n_segments, floor):
+            return p
+    return DELTA_PADS[-1]
+
+
+@functools.partial(jax.jit, static_argnames=("F", "Fs", "P",
+                                             "n_states",
+                                             "n_transitions"))
+def stream_delta_chunk(succ, inv_proc, inv_tr, ok_proc, depth,
+                       seg_offset, carry, *, F: int, Fs: int, P: int,
+                       n_states: int, n_transitions: int):
+    """One delta dispatch of the XLA session rung: the adaptive
+    two-tier segmented scan resumed from (and returning) a
+    device-resident carry. Identical semantics to
+    :func:`~comdb2_tpu.checker.linear_jax.check_device_seg2_chunk`;
+    a separate jit name because THIS entry is serving surface — its
+    shapes are drawn from the closed ``stream-delta`` ladder
+    (PROGRAMS.md), where the driver chunk entry is an open site."""
+    bits = LJ._bits_for(n_states, n_transitions, P)
+    S = inv_proc.shape[0]
+    segs = (inv_proc, inv_tr, ok_proc,
+            seg_offset + jnp.arange(S, dtype=jnp.int32), depth)
+    step = LJ._make_seg_step(succ, F, P, inv_proc.shape[1], bits,
+                             Fs=LJ._seg2_tier(Fs, F))
+    carry2, _ = lax.scan(step, carry, segs)
+    return carry2
+
+
+def _host_seg_carry(F: int, P: int):
+    """Host-numpy initial carry (init_seg_carry's values): the first
+    delta's jit transfers it — building it with eager jnp ops would
+    compile infra programs OUTSIDE the declared surface (scatter/
+    squeeze per carry shape), and the guard would rightly flag them."""
+    valid = np.zeros(F, bool)
+    valid[0] = True
+    return (np.zeros(F, np.int32),
+            np.full((F, P), LJ.IDLE, np.int32), valid,
+            np.int32(1), np.int32(LJ.VALID), np.int32(-1))
+
+
+def _host_expand(carry, F_new: int):
+    """``expand_seg_carry`` in host numpy (escalations are rare; the
+    one-time readback is cheaper than an off-inventory pad program)."""
+    states, slots, valid, count, _s, _f = (np.asarray(x)
+                                           for x in carry)
+    pad = F_new - states.shape[0]
+    if pad < 0:
+        raise ValueError("carry wider than target capacity")
+    return (np.pad(states, (0, pad)),
+            np.pad(slots, ((0, pad), (0, 0)),
+                   constant_values=LJ.IDLE),
+            np.pad(valid, (0, pad)), count,
+            np.int32(LJ.VALID), np.int32(-1))
+
+
+class XlaCarry:
+    """The XLA rung (see module docstring). ``sizes`` are the
+    POW2-BUCKETED memo dims (the static shape args — raw counts here
+    would compile per history, the ``unbucketed-dispatch-site``
+    hazard)."""
+
+    name = "stream-xla"
+
+    def __init__(self, n_states: int, n_transitions: int, P2: int,
+                 cap_ix: int = 0):
+        self.ns = n_states
+        self.nt = n_transitions
+        self.P2 = P2
+        self.cap_ix = cap_ix
+        self.F = STREAM_CAPACITIES[cap_ix]
+        self.carry = _host_seg_carry(self.F, P2)
+        self._pre = self.carry          # pre-delta snapshot
+
+    def begin_delta(self) -> None:
+        self._pre = self.carry
+
+    def dispatch(self, succ, ip, it, okp, dp, seg_offset) -> None:
+        global DISPATCHES
+        DISPATCHES += 1
+        self.carry = stream_delta_chunk(
+            succ, ip, it, okp, dp, np.int32(seg_offset), self.carry,
+            F=self.F, Fs=STREAM_FS, P=self.P2, n_states=self.ns,
+            n_transitions=self.nt)
+
+    def read(self) -> Tuple[int, int, int]:
+        """(status, fail_seg_global, n_final) — blocks on the device."""
+        return (int(self.carry[4]), int(self.carry[5]),
+                int(self.carry[3]))
+
+    def escalate(self) -> bool:
+        """Widen the PRE-delta carry to the next rung; the caller
+        re-dispatches the same delta. False at the ladder top."""
+        if self.cap_ix + 1 >= len(STREAM_CAPACITIES):
+            return False
+        self.cap_ix += 1
+        self.F = STREAM_CAPACITIES[self.cap_ix]
+        self.carry = _host_expand(self._pre, self.F)
+        self._pre = self.carry
+        return True
+
+    def widen_slots(self, P2_new: int) -> bool:
+        """Slot-axis growth IN PLACE (the rung survives concurrency
+        growth without replay)."""
+        self.carry = LJ.expand_seg_carry_slots(self.carry, P2_new)
+        self._pre = LJ.expand_seg_carry_slots(self._pre, P2_new)
+        self.P2 = P2_new
+        return True
+
+    def rebucket(self, n_states: int, n_transitions: int) -> bool:
+        """Memo-table bucket growth: the carry is portable (state ids
+        stable, key layout internal) — just retarget the static dims."""
+        self.ns, self.nt = n_states, n_transitions
+        return True
+
+    def nbytes(self) -> int:
+        st, sl, va = self.carry[0], self.carry[1], self.carry[2]
+        return int(st.size * 4 + sl.size * 4 + va.size)
+
+
+class MxuCarry:
+    """The MXU rung: packed-word carry, B=1 chunk form."""
+
+    name = "stream-mxu"
+
+    def __init__(self, n_states: int, n_transitions: int, P2: int,
+                 cap_ix: int = 0):
+        self.ns = n_states
+        self.nt = n_transitions
+        self.P2 = P2
+        self.cap_ix = cap_ix
+        self.F = MXU.CAPACITIES[cap_ix]
+        self.carry = MXU.init_carry(1, self.F, P2,
+                                    n_states=n_states,
+                                    n_transitions=n_transitions)
+        self._pre = self.carry
+
+    def begin_delta(self) -> None:
+        self._pre = self.carry
+
+    def dispatch(self, succ, ip, it, okp, dp, seg_offset) -> None:
+        global DISPATCHES
+        DISPATCHES += 1
+        self.carry = MXU.check_device_mxu_chunk(
+            succ, ip, it, okp, dp, np.int32(seg_offset), self.carry,
+            F=self.F, P=self.P2, n_states=self.ns,
+            n_transitions=self.nt)
+
+    def read(self) -> Tuple[int, int, int]:
+        return (int(self.carry[3][0]), int(self.carry[4][0]),
+                int(self.carry[2][0]))
+
+    def escalate(self) -> bool:
+        if self.cap_ix + 1 >= len(MXU.CAPACITIES):
+            return False
+        self.cap_ix += 1
+        self.F = MXU.CAPACITIES[self.cap_ix]
+        self.carry = MXU.expand_carry(self._pre, self.F)
+        self._pre = self.carry
+        return True
+
+    def widen_slots(self, P2_new: int) -> bool:
+        return False                    # word layout bakes P: replay
+
+    def rebucket(self, n_states: int, n_transitions: int) -> bool:
+        return False                    # PackPlan re-plans: replay
+
+    def nbytes(self) -> int:
+        words, valid = self.carry[0], self.carry[1]
+        return int(sum(w.size * 4 for w in words) + valid.size)
+
+
+class KernelCarry:
+    """The fused-kernel rung: (ws, stat) word carry threaded through
+    per-chunk Mosaic calls at the session's global segment offset.
+    F is the kernel's fixed 128; any overflow or growth event
+    re-routes (replay on the next rung)."""
+
+    name = "stream-kernel"
+
+    def __init__(self, spec, n_states: int, n_transitions: int):
+        self.spec = spec
+        self.ns = n_states
+        self.nt = n_transitions
+        self.ws = tuple(jnp.asarray(w)
+                        for w in PSEG.initial_frontier(spec))
+        self.stat = jnp.asarray(PSEG._init_stat())
+        self._res = jnp.zeros((8, PSEG.LANES), jnp.int32)
+        self._pre = (self.ws, self.stat)
+
+    def begin_delta(self) -> None:
+        self._pre = (self.ws, self.stat)
+
+    def dispatch(self, table, chunks, seg_offset) -> None:
+        """``chunks``: (n_chunks, chunk, 2+2K) from ``pack_segments``;
+        the offsets bias fail indices into session-global segment
+        coordinates."""
+        global DISPATCHES
+        call = stream_kernel_chunk(self.spec)
+        for c in range(chunks.shape[0]):
+            DISPATCHES += 1
+            off = np.array([seg_offset + c * self.spec.chunk,
+                            self.nt], np.int32)
+            self.ws, self.stat, self._res = call(
+                jnp.asarray(chunks[c]), jnp.asarray(off), self.ws,
+                self.stat, self._res, table)
+
+    def read(self) -> Tuple[int, int, int]:
+        st = np.asarray(self.stat)
+        return int(st[0, 0]), int(st[0, 1]), int(st[0, 2])
+
+    def escalate(self) -> bool:
+        return False                    # F fixed at 128: re-route
+
+    def widen_slots(self, P2_new: int) -> bool:
+        return False                    # spec bakes P: re-route
+
+    def rebucket(self, n_states: int, n_transitions: int) -> bool:
+        return False                    # spec bakes the table: re-route
+
+    def nbytes(self) -> int:
+        return int(sum(w.size * 4 for w in self.ws)
+                   + self.stat.size * 4)
+
+
+@functools.lru_cache(maxsize=16)
+def stream_kernel_chunk(spec):
+    """Jitted single-chunk kernel call under the session rung's OWN
+    compile-log name (``_chunk_call``'s inner ``call`` is the open
+    driver path; serving-surface programs must carry a declared
+    name — PROGRAMS.md ``stream-delta``)."""
+    call = PSEG._chunk_call(spec)
+
+    def stream_kernel_delta(seg, off, ws, stat, res, table):
+        return call(seg, off, ws, stat, res, table)
+
+    return jax.jit(stream_kernel_delta)
+
+
+def kernel_spec(n_states: int, n_transitions: int, P2: int,
+                K: int) -> Optional[object]:
+    """The session's kernel spec, or None when the shape can't run
+    fused (the caller then picks the MXU/XLA rung)."""
+    if not PSEG.available():
+        return None
+    return PSEG.spec_for(n_states, n_transitions, P2, K + (K & 1))
+
+
+def pick_rung(n_states: int, n_transitions: int, P2: int, K: int,
+              engine: str = "auto") -> str:
+    """Rung policy, mirroring the driver ladder: kernel when the
+    fused spec serves the shape, MXU for wide P, XLA otherwise.
+    ``engine`` forces a specific rung (tests / ``--engine``)."""
+    if engine in ("kernel", "mxu", "xla"):
+        return engine
+    if P2 <= 2 * PSEG.ROWS - 1 and K <= 8 \
+            and kernel_spec(n_states, n_transitions, P2, K) is not None:
+        return "kernel"
+    if MXU.serves(n_states, n_transitions, P2):
+        return "mxu"
+    return "xla"
+
+
+def pad_sizes(n_states: int, n_transitions: int) -> Tuple[int, int]:
+    """Pow2 memo-dim buckets (the ``stream-delta`` site's table axes —
+    every dispatch must route raw counts through here)."""
+    return _next_pow2(n_states), _next_pow2(n_transitions)
+
+
+__all__ = ["DELTA_PADS", "DISPATCHES", "KernelCarry", "MXU_DELTA_FLOOR",
+           "MxuCarry", "STREAM_CAPACITIES", "STREAM_MAX_K",
+           "STREAM_MAX_P", "XlaCarry", "bucket_delta", "kernel_spec",
+           "pad_sizes", "pick_rung", "stream_delta_chunk",
+           "stream_kernel_chunk"]

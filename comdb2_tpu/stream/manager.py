@@ -1,0 +1,95 @@
+"""Session table — ids, caps, TTL/idle eviction, carry accounting.
+
+The service owns one :class:`SessionManager`; every verb resolves the
+session id through it. Two production guards live here:
+
+- ``max_sessions``: a carry is real device memory — the cap answers
+  ``open`` with overload (+ ``retry_after_ms``) instead of silently
+  OOMing the accelerator under a session flood.
+- idle eviction: a session nobody appended to for ``idle_s`` releases
+  its carry (the devices' analog of a KV-cache eviction); the client
+  re-opens by replaying its retained deltas (session affinity +
+  failover replay, docs/streaming.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import trace as _obs
+from .session import StreamSession
+
+
+class SessionLimit(Exception):
+    """``max_sessions`` reached — the service maps this to an
+    overload reply with a ``retry_after_ms`` hint."""
+
+
+class SessionManager:
+    """See module docstring. All times are ``obs.trace.monotonic``
+    floats passed in by the caller (the daemon owns the clock)."""
+
+    def __init__(self, max_sessions: int = 64,
+                 idle_s: float = 300.0):
+        self.max_sessions = int(max_sessions)
+        self.idle_s = float(idle_s)
+        self._sessions: Dict[str, StreamSession] = {}
+        self._touched: Dict[str, float] = {}
+        self._seq = itertools.count()
+        self.evictions = 0
+        self.opened = 0
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def open(self, now: float, model: str = "cas-register",
+             engine: str = "auto",
+             max_states: int = 1 << 20) -> Tuple[str, StreamSession]:
+        if len(self._sessions) >= self.max_sessions:
+            raise SessionLimit(
+                f"session table at cap ({self.max_sessions})")
+        sid = f"s{next(self._seq)}-{os.urandom(3).hex()}"
+        s = StreamSession(model=model, engine=engine,
+                          max_states=max_states)
+        self._sessions[sid] = s
+        self._touched[sid] = now
+        self.opened += 1
+        return sid, s
+
+    def get(self, sid, now: Optional[float] = None
+            ) -> Optional[StreamSession]:
+        s = self._sessions.get(sid)
+        if s is not None and now is not None:
+            self._touched[sid] = now
+        return s
+
+    def close(self, sid) -> Optional[dict]:
+        s = self._sessions.pop(sid, None)
+        self._touched.pop(sid, None)
+        if s is None:
+            return None
+        return s.close()
+
+    def evict_idle(self, now: float) -> List[str]:
+        """Release every session idle past the TTL (carry freed; the
+        session object dies — re-open replays client-side)."""
+        out = []
+        for sid, t in list(self._touched.items()):
+            if now - t >= self.idle_s:
+                s = self._sessions.pop(sid, None)
+                self._touched.pop(sid, None)
+                if s is not None:
+                    s.release()         # forces any in-flight staged
+                    out.append(sid)     # append through finalize
+                    self.evictions += 1
+                    _obs.record("stream.evict", now, now, sid=sid)
+        return out
+
+    def carry_bytes(self) -> int:
+        return sum(s.carry_nbytes()
+                   for s in self._sessions.values())
+
+
+__all__ = ["SessionLimit", "SessionManager"]

@@ -1,0 +1,499 @@
+"""StreamSession — one monitored live history, one resident carry.
+
+The session composes the incremental layers into the streaming
+verification loop (docs/streaming.md):
+
+    append(ops) -> ingest delta        (columnar, watermark-settled)
+               -> extend memo          (state ids stable)
+               -> segment + rename     (tail + renamer carried)
+               -> dispatch NEW segments against the resident carry
+               -> verdict-so-far       (latched once terminal)
+
+Per-append device work is O(delta). The only O(history) events are
+engine RE-ROUTES (kernel frontier overflow, MXU re-plan after table
+or concurrency growth), which replay the session's retained renamed
+segment stream onto a fresh rung — the same retained tables a
+failover re-open replays (docs/streaming.md "Failover").
+
+Verdicts LATCH: linearizability of a prefix is monotone — once a
+prefix is non-linearizable every extension is, so an INVALID (or a
+terminal UNKNOWN) answers later appends immediately without touching
+the device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from ..models.memo import IncrementalMemo, MemoOverflow
+from ..models.model import MODELS, Model
+from ..obs import trace as _obs
+from ..utils import next_pow2 as _next_pow2
+from . import engine as ENG
+from .ingest import MalformedDelta, StreamIngest
+from .segment import StreamSegmenter
+
+VALID, INVALID, UNKNOWN = 0, 1, 2
+
+
+def _even(p: int) -> int:
+    p = max(p, 2)
+    return p + (p & 1)
+
+
+class StreamSession:
+    """See module docstring. ``engine`` forces a rung ("kernel" /
+    "mxu" / "xla"); "auto" follows the driver ladder. ``max_states``
+    caps the incremental memo (overflow latches UNKNOWN, the honest
+    tri-state)."""
+
+    def __init__(self, model: Union[str, Model] = "cas-register",
+                 engine: str = "auto", max_states: int = 1 << 20):
+        if isinstance(model, str):
+            if model not in MODELS:
+                raise ValueError(f"unknown model {model!r}")
+            self.model_name = model
+            model = MODELS[model]()
+        else:
+            self.model_name = type(model).__name__
+        self.engine_policy = engine
+        self.ingest = StreamIngest()
+        self.seg = StreamSegmenter()
+        self.memo = IncrementalMemo(model, max_states=max_states)
+        self._eng = None
+        self._rung: Optional[str] = None
+        self._succ_dev = None
+        self._succ_key = None
+        self._table_dev = None        # kernel rung's packed table
+        self._table_key = None
+        self.P2 = 2
+        self.dispatched_segments = 0  # prefix already on the carry
+        self.appends = 0
+        self.dispatches = 0           # session-local delta dispatches
+        self.replays = 0
+        self.valid: Union[bool, str, None] = True
+        self.cause: Optional[str] = None
+        self.fail_index: int = -1
+        self.final_count: int = 1
+        self.engines_tried: List[dict] = []
+        self.closed = False
+        self._inflight = None
+
+    # -- public API ----------------------------------------------------
+
+    def append(self, ops) -> dict:
+        """Ingest one delta, dispatch its new segments, return the
+        verdict-so-far map (synchronous form)."""
+        fin = self.append_stage(ops)
+        return fin()
+
+    def append_stage(self, ops):
+        """Stage one append (ingest + async dispatch) and return a
+        zero-arg finalize producing the verdict map — the service tick
+        overlaps other sessions' host work with this one's device run.
+        Appends to one session serialize: staging while an earlier
+        append is unfinalized finalizes it first."""
+        if self._inflight is not None:
+            self._inflight()
+        if self.closed:
+            out = self._verdict_map()
+            out["cause"] = "session closed"
+            return lambda: out
+        self.appends += 1
+        if self._latched():
+            # the latch: a non-linearizable prefix stays
+            # non-linearizable under every extension — answer without
+            # ingesting or touching the device
+            out = self._verdict_map()
+            out["latched"] = True
+            return lambda: out
+        try:
+            with _obs.span("stream.ingest", n=len(ops)):
+                lo, hi = self.ingest.append(list(ops))
+        except MalformedDelta as e:
+            self._latch_unknown(f"malformed: {e}")
+            return lambda: self._verdict_map()
+        return self._stage_settled(lo, hi)
+
+    def finalize_input(self) -> dict:
+        """End of stream: settle the tail (open invokes keep their
+        invoked values, one-shot parity) and dispatch whatever oks
+        that unblocks. The final verdict map is bit-identical to a
+        one-shot ``check_batch`` of the full history."""
+        if self._inflight is not None:
+            self._inflight()
+        if self.closed or self._latched():
+            return self._verdict_map()
+        lo, hi = self.ingest.finalize()
+        return self._stage_settled(lo, hi)()
+
+    def poll(self) -> dict:
+        if self._inflight is not None:
+            self._inflight()
+        return self._verdict_map()
+
+    def close(self) -> dict:
+        """Finalize, release the device carry, reject further work."""
+        out = self.finalize_input()
+        self.release()
+        return out
+
+    def release(self) -> None:
+        """Drop the device carry WITHOUT the final tail settle — the
+        eviction path. Forces any in-flight staged append through its
+        (idempotent) finalize first, so a ring-resident dispatch can
+        never read a released engine."""
+        if self._inflight is not None:
+            self._inflight()
+        self._eng = None
+        self._succ_dev = None
+        self._table_dev = None
+        self.closed = True
+
+    def carry_nbytes(self) -> int:
+        return self._eng.nbytes() if self._eng is not None else 0
+
+    @property
+    def shape_class(self) -> str:
+        """The session's compiled-shape class — service slot
+        coalescing keys on it (same forming batches as one-shot
+        traffic with the same programs)."""
+        ns, nt = ENG.pad_sizes(max(self.memo.n_states, 1),
+                               max(self.memo.n_transitions, 1))
+        return (f"stream-{self._rung or 'new'}-p{self.P2}"
+                f"-k{self._k_bucket()}-t{ns}x{nt}")
+
+    def counterexample(self, F: int = 4096):
+        """Bounded failing-config reconstruction on the retained
+        columnar tables (the owner-map decode path — API edge)."""
+        if self.valid is not False:
+            return None
+        from ..checker import counterexample as CE
+
+        packed = self.ingest.packed_history()
+        return CE.reconstruct(self.memo.as_memoized(), packed,
+                              F=max(256, min(F, 65536)))
+
+    # -- staging -------------------------------------------------------
+
+    def _stage_settled(self, lo: int, hi: int):
+        try:
+            self._extend_memo()
+            with _obs.span("stream.segment", lo=lo, hi=hi):
+                s_lo, s_hi = self.seg.feed(self.ingest, lo, hi)
+        except MemoOverflow as e:
+            self._latch_unknown(f"memo overflow: {e}")
+            return lambda: self._verdict_map()
+        except ValueError as e:
+            self._latch_unknown(f"malformed: {e}")
+            return lambda: self._verdict_map()
+        if s_hi == s_lo:
+            return lambda: self._verdict_map()
+        if _even(self.seg.p_eff) > ENG.STREAM_MAX_P \
+                or self._k_bucket() > ENG.STREAM_MAX_K:
+            # past the declared stream-delta ladder there is no
+            # program to run (and a genuinely concurrent P>32 closure
+            # is a 2^P frontier nothing searches anyway): the honest
+            # tri-state, latched — NOT an off-inventory compile per
+            # growth step
+            self._latch_unknown(
+                f"concurrency beyond the stream ladder (P_eff="
+                f"{self.seg.p_eff} > {ENG.STREAM_MAX_P} or K="
+                f"{self.seg.k_max} > {ENG.STREAM_MAX_K})")
+            return lambda: self._verdict_map()
+        try:
+            self._maintain_shapes()
+            with _obs.span("stream.dispatch", s_lo=s_lo, s_hi=s_hi,
+                           engine=self._rung):
+                self._dispatch_range(s_lo, s_hi)
+        except Exception as e:          # noqa: BLE001 — engine blowup
+            self._latch_unknown(f"engine: {type(e).__name__}: {e}")
+            return lambda: self._verdict_map()
+
+        done: dict = {}
+
+        def finalize():
+            # idempotent: the service's batch finish() calls every
+            # staged fin, but an append staged AFTER this one in the
+            # same batch already forced it through the session's
+            # inflight serialization — a second _finalize_range
+            # against the later delta's carry would re-apply segments
+            if "out" in done:
+                return done["out"]
+            self._inflight = None
+            try:
+                self._finalize_range(s_lo, s_hi)
+            except Exception as e:      # noqa: BLE001
+                self._latch_unknown(
+                    f"engine: {type(e).__name__}: {e}")
+            done["out"] = self._verdict_map()
+            return done["out"]
+
+        self._inflight = finalize
+        return finalize
+
+    # -- shape maintenance ---------------------------------------------
+
+    def _k_bucket(self) -> int:
+        return _next_pow2(self.seg.k_max, 2)
+
+    def _extend_memo(self) -> None:
+        known = self.memo.n_transitions
+        new = self.ingest.transitions_of(known,
+                                         len(self.ingest
+                                             .transition_table))
+        self.memo.extend(new, self.ingest.n_invokes_settled)
+
+    def _maintain_shapes(self) -> None:
+        """Grow-events between appends: concurrency (P_eff), table
+        buckets, K. Rungs that absorb growth in place do; the rest
+        replay the retained segments onto a re-picked rung."""
+        ns, nt = ENG.pad_sizes(max(self.memo.n_states, 1),
+                               max(self.memo.n_transitions, 1))
+        P2 = _even(self.seg.p_eff)
+        if self._eng is None:
+            self.P2 = P2
+            self._rung = ENG.pick_rung(ns, nt, P2, self.seg.k_max,
+                                       self.engine_policy)
+            self._eng = self._make_engine(self._rung, ns, nt, P2)
+            return
+        replay = False
+        if P2 > self.P2:
+            # concurrency growth can cross an engine crossover (the
+            # kernel's P<=15 tiers, the MXU's P>=16 ownership) — a
+            # rung change is a replay, widening in place is not
+            preferred = ENG.pick_rung(ns, nt, P2, self.seg.k_max,
+                                      self.engine_policy)
+            if preferred != self._rung or not self._eng.widen_slots(P2):
+                replay = True
+            self.P2 = P2
+        if (ns, nt) != self._eng_sizes():
+            if not self._eng.rebucket(ns, nt):
+                replay = True
+        if self._rung == "kernel" \
+                and self.seg.k_max > self._eng.spec.K:
+            replay = True               # spec bakes K
+        if replay:
+            self._reroute(note="growth")
+
+    def _eng_sizes(self):
+        return self._eng.ns, self._eng.nt
+
+    def _make_engine(self, rung: str, ns: int, nt: int, P2: int):
+        if rung == "kernel":
+            spec = ENG.kernel_spec(ns, nt, P2, self.seg.k_max)
+            if spec is None:            # shape outgrew the kernel —
+                # attributed, so a forced engine="kernel" caller can
+                # see the substitution instead of silently measuring
+                # the wrong rung
+                self.engines_tried.append(
+                    {"engine": "stream-kernel",
+                     "note": "spec unavailable for shape",
+                     "frontier_capacity": None})
+                rung = ("mxu" if ENG.MXU.serves(ns, nt, P2)
+                        else "xla")
+                self._rung = rung
+            else:
+                self._table_dev = None
+                return ENG.KernelCarry(spec, ns, nt)
+        if rung == "mxu":
+            if ENG.MXU.serves(ns, nt, P2):
+                return ENG.MxuCarry(ns, nt, P2)
+            # same attribution contract as the kernel branch: a
+            # forced engine="mxu" caller must see the substitution
+            self.engines_tried.append(
+                {"engine": "stream-mxu",
+                 "note": "engine does not serve this shape",
+                 "frontier_capacity": None})
+        self._rung = "xla"
+        return ENG.XlaCarry(ns, nt, P2)
+
+    # -- dispatch ------------------------------------------------------
+
+    def _succ_device(self):
+        import jax
+
+        from ..checker import linear_jax as LJ
+
+        ns, nt = self._eng_sizes()
+        key = (self.memo.version, ns, nt)
+        if self._succ_key != key:
+            self._succ_dev = jax.device_put(
+                LJ.pad_succ(self.memo.succ, ns, nt))
+            self._succ_key = key
+            self._table_dev = None
+        return self._succ_dev
+
+    def _kernel_table(self):
+        import jax.numpy as jnp
+
+        from ..checker import linear_jax as LJ
+        from ..checker import pallas_seg as PSEG
+
+        # keyed on memo.version: a new transition interned WITHIN the
+        # same pow2 bucket changes table content without any shape
+        # event, and a stale table would misdecode its successors.
+        # The table packs the BUCKET-padded succ because the kernel's
+        # runtime flat-index stride is the rung's declared nt
+        # (KernelCarry off[1]) — packing the exact-width memo.succ
+        # against a padded stride would misalign every state>0 row.
+        key = (self.memo.version, self._eng.ns, self._eng.nt)
+        if self._table_dev is None or self._table_key != key:
+            spec = self._eng.spec
+            padded = LJ.pad_succ(self.memo.succ, self._eng.ns,
+                                 self._eng.nt)
+            self._table_dev = jnp.asarray(PSEG.pack_table(
+                padded, spec.table_rows_pad))
+            self._table_key = key
+        return self._table_dev
+
+    def _dispatch_range(self, s_lo: int, s_hi: int) -> None:
+        """Dispatch segments [s_lo, s_hi) against the resident carry,
+        bucketed on the delta_pad ladder (one pre-delta snapshot for
+        the whole range — escalation re-runs the range)."""
+        self._eng.begin_delta()
+        self._dispatch_chunks(s_lo, s_hi)
+
+    def _dispatch_chunks(self, s_lo: int, s_hi: int) -> None:
+        if self._rung == "kernel":
+            from ..checker import linear_jax as LJ
+            from ..checker import pallas_seg as PSEG
+
+            spec = self._eng.spec
+            ip, it, okp, dp = self.seg.padded(
+                s_lo, s_hi, s_hi - s_lo, spec.K)
+            segs = LJ.SegmentStream(ip, it, okp,
+                                    self.seg.seg_row.a[s_lo:s_hi], dp)
+            chunks = PSEG.pack_segments(segs, spec)
+            self._eng.dispatch(self._kernel_table(), chunks, s_lo)
+            self.dispatches += chunks.shape[0]
+            return
+        succ = self._succ_device()
+        floor = ENG.MXU_DELTA_FLOOR if self._rung == "mxu" else 0
+        k_pad = self._k_bucket()
+        pos = s_lo
+        while pos < s_hi:
+            n = min(s_hi - pos, ENG.DELTA_PADS[-1])
+            s_pad = ENG.bucket_delta(n, floor)
+            n = min(n, s_pad)
+            ip, it, okp, dp = self.seg.padded(pos, pos + n, s_pad,
+                                              k_pad)
+            self._eng.dispatch(succ, ip, it, okp, dp, pos)
+            self.dispatches += 1
+            pos += n
+
+    def _finalize_range(self, s_lo: int, s_hi: int) -> None:
+        st, fail_seg, n = self._eng.read()
+        while st == UNKNOWN:
+            if self._eng.escalate():
+                # in-place capacity escalation: the pre-delta carry
+                # widened, only this append's segments re-run
+                self._dispatch_chunks(s_lo, s_hi)
+                st, fail_seg, n = self._eng.read()
+                continue
+            nxt = self._next_rung()
+            if nxt is None:
+                self._latch(UNKNOWN, fail_seg, n)
+                return
+            self._reroute(note="frontier overflow", rung=nxt,
+                          through=s_hi)
+            st, fail_seg, n = self._eng.read()
+        self.dispatched_segments = s_hi
+        self._latch(st, fail_seg, n)
+
+    def _next_rung(self) -> Optional[str]:
+        ns, nt = ENG.pad_sizes(max(self.memo.n_states, 1),
+                               max(self.memo.n_transitions, 1))
+        if self._rung == "kernel":
+            return ("mxu" if ENG.MXU.serves(ns, nt, self.P2)
+                    else "xla")
+        if self._rung == "xla" \
+                and ENG.MXU.serves(ns, nt, self.P2):
+            return "mxu"                # 2x the XLA top rung
+        return None
+
+    def _reroute(self, note: str, rung: Optional[str] = None,
+                 through: Optional[int] = None) -> None:
+        """The one O(history) event: rebuild the carry on a new (or
+        re-shaped) rung and replay the RETAINED renamed segments.
+        Amortized over the session's life; counted + attributed."""
+        if self._eng is not None:
+            self.engines_tried.append({
+                "engine": self._eng.name, "note": note,
+                "frontier_capacity": getattr(self._eng, "F", 128)})
+        ns, nt = ENG.pad_sizes(max(self.memo.n_states, 1),
+                               max(self.memo.n_transitions, 1))
+        self._rung = rung or ENG.pick_rung(ns, nt, self.P2,
+                                           self.seg.k_max,
+                                           self.engine_policy)
+        self._succ_key = None
+        self._eng = self._make_engine(self._rung, ns, nt, self.P2)
+        self.replays += 1
+        end = self.dispatched_segments if through is None else through
+        with _obs.span("stream.replay", rung=self._rung, through=end):
+            pos = 0
+            while pos < end:
+                n = min(end - pos, ENG.DELTA_PADS[-1])
+                self._eng.begin_delta()
+                self._dispatch_chunks(pos, pos + n)
+                st, _, _ = self._eng.read()
+                if st == UNKNOWN:
+                    if self._eng.escalate():
+                        continue        # same chunk, wider frontier
+                    nxt = self._next_rung()
+                    if nxt is None:
+                        return          # caller's read sees UNKNOWN
+                    return self._reroute(note="frontier overflow",
+                                         rung=nxt, through=end)
+                if st != VALID:
+                    return              # caller's read latches it
+                pos += n
+
+    # -- verdict -------------------------------------------------------
+
+    def _latched(self) -> bool:
+        return self.valid is not True
+
+    def _latch(self, st: int, fail_seg: int, n: int) -> None:
+        self.final_count = int(n)
+        if st == VALID:
+            return
+        self.fail_index = (int(self.seg.seg_row.a[fail_seg])
+                           if 0 <= fail_seg < self.seg.n_segments
+                           else -1)
+        if st == INVALID:
+            self.valid = False
+        else:
+            self.valid = "unknown"
+            self.cause = (f"frontier overflow (engine="
+                          f"{self._eng.name if self._eng else '?'}, "
+                          f"capacity="
+                          f"{getattr(self._eng, 'F', 128)})")
+
+    def _latch_unknown(self, cause: str) -> None:
+        self.valid = "unknown"
+        self.cause = cause
+
+    def _verdict_map(self) -> dict:
+        out = {
+            "valid": self.valid,
+            "op_index": self.fail_index,
+            "final_count": self.final_count,
+            "op_count": len(self.ingest),
+            "checked_through": self.ingest.settled,
+            "segments": self.seg.n_segments,
+            "engine": self._rung or "none",
+            "dispatches": self.dispatches,
+            "appends": self.appends,
+            "replays": self.replays,
+        }
+        if self._eng is not None:
+            out["frontier_capacity"] = getattr(self._eng, "F", 128)
+        if self.cause:
+            out["cause"] = self.cause
+        if self.engines_tried:
+            out["engines_tried"] = self.engines_tried
+        return out
+
+
+__all__ = ["StreamSession"]

@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Bench the streaming-session subsystem: per-append cost vs
+re-checking the full prefix from scratch.
+
+Usage: PYTHONPATH=$AXON_SITE:. python scripts/bench_stream.py \
+           [--json BENCH_stream.json] [--quick]
+(real TPU or CPU smoke via JAX_PLATFORMS=cpu.)
+
+The headline is the INCREMENTAL WIN: a session's append dispatches
+only the delta's new segments against the device-resident carry, so
+per-append wall time is independent of how much history the session
+has accumulated — where a post-hoc re-check of the full prefix
+(pack + segment + one-shot dispatch, what every pre-stream surface
+does) grows linearly with it. Both sides are measured at every
+checkpoint and the flatness/growth ratios are asserted.
+
+The ~100 ms tunnel dispatch+readback round-trip (CLAUDE.md) is
+DECLARED in the artifact, not injected: on the tunneled TPU both an
+append and a scratch re-check pay one round-trip per dispatch, so the
+modeled numbers add 100 ms x dispatch count to each side — the
+incremental win survives the model because both sides pay one
+round-trip while only scratch pays the O(history) scan + host pack.
+
+Verdict parity between the session and every scratch re-check is
+HARD-ASSERTED before any timing counts, and the run's compile-guard
+summary is embedded (observed lowerings ⊆ PROGRAMS.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+#: the measured tunnel dispatch+readback round-trip this container's
+#: TPU link pays (CLAUDE.md) — declared in the artifact model
+TUNNEL_ROUNDTRIP_MS = 100.0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="BENCH_stream.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shape (CI smoke)")
+    ap.add_argument("--events", type=int, default=4096)
+    ap.add_argument("--delta", type=int, default=256)
+    args = ap.parse_args()
+    if args.quick:
+        args.events, args.delta = 960, 96
+
+    from comdb2_tpu.checker.batch import check_batch, pack_batch
+    from comdb2_tpu.models.model import MODELS
+    from comdb2_tpu.ops.packed import pack_history
+    from comdb2_tpu.ops.synth import register_history
+    from comdb2_tpu.stream import StreamSession
+    from comdb2_tpu.stream import engine as ENG
+    from comdb2_tpu.utils import compile_guard
+
+    h = register_history(random.Random(13), n_procs=4,
+                         n_events=args.events, values=3,
+                         p_info=0.0, max_pending=2)
+    model = MODELS["cas-register"]()
+
+    def scratch(prefix):
+        t0 = time.perf_counter()
+        b = pack_batch([pack_history(list(prefix))], model)
+        st, fa, nf = check_batch(b, F=1024)
+        return ((time.perf_counter() - t0) * 1e3,
+                (int(st[0]), int(fa[0]), int(nf[0])))
+
+    n_deltas = -(-args.events // args.delta)
+    checkpoint_sizes = [(k + 1) * args.delta
+                        for k in range(n_deltas) if k % 4 == 3]
+
+    with compile_guard.guard() as g:
+        # warm both paths' programs so timings measure dispatch, not
+        # compile (the service primes the same way at boot): EVERY
+        # scratch checkpoint prefix crosses its own pow2 segment
+        # bucket and must compile before the timed region, or
+        # scratch_ms inflates with first-time compiles and the
+        # incremental win overstates
+        for size in checkpoint_sizes:
+            scratch(h[:size])
+        warm = StreamSession("cas-register")
+        for i in range(0, 3 * args.delta, args.delta):
+            warm.append(h[i:i + args.delta])
+        warm.close()
+
+        s = StreamSession("cas-register")
+        append_ms = []
+        scratch_ms = []
+        checkpoints = []
+        d0 = ENG.DISPATCHES
+        for i in range(0, args.events, args.delta):
+            t0 = time.perf_counter()
+            out = s.append(h[i:i + args.delta])
+            append_ms.append((time.perf_counter() - t0) * 1e3)
+            if (i // args.delta) % 4 == 3:
+                sm, verdict = scratch(h[:i + args.delta])
+                scratch_ms.append(sm)
+                checkpoints.append(i + args.delta)
+                final = s.poll()
+                assert verdict[0] == {True: 0, False: 1,
+                                      "unknown": 2}[final["valid"]], \
+                    (verdict, final)
+        out = s.finalize_input()
+        n_disp = ENG.DISPATCHES - d0
+        assert out["valid"] is True, out
+
+    n = len(append_ms)
+    head = sum(append_ms[:4]) / 4
+    tail = sum(append_ms[-4:]) / 4
+    # the claim: per-append cost independent of accumulated history —
+    # the last appends may not cost more than ~2x the first (noise
+    # floor on one CPU), while scratch grows with the prefix
+    flat = tail <= 2.0 * max(head, 1.0)
+    growth = (scratch_ms[-1] / max(scratch_ms[0], 1e-9)
+              if len(scratch_ms) >= 2 else None)
+    result = {
+        "bench": "stream",
+        "backend": __import__("jax").default_backend(),
+        "events": args.events,
+        "delta": args.delta,
+        "appends": n,
+        "dispatches": n_disp,
+        "append_ms": {"head4": round(head, 3),
+                      "tail4": round(tail, 3),
+                      "mean": round(sum(append_ms) / n, 3),
+                      "max": round(max(append_ms), 3)},
+        "per_append_flat": flat,
+        "scratch_checkpoints": checkpoints,
+        "scratch_ms": [round(x, 3) for x in scratch_ms],
+        "scratch_growth": round(growth, 2) if growth else None,
+        "incremental_win_at_end": round(
+            scratch_ms[-1] / max(tail, 1e-9), 2),
+        "tunnel_model": {
+            "dispatch_roundtrip_ms": TUNNEL_ROUNDTRIP_MS,
+            "modeled_append_ms": round(tail + TUNNEL_ROUNDTRIP_MS, 3),
+            "modeled_scratch_ms": round(
+                scratch_ms[-1] + TUNNEL_ROUNDTRIP_MS, 3),
+            "note": "both sides pay one ~100 ms tunnel round-trip "
+                    "per dispatch on the real TPU; only scratch "
+                    "pays the O(history) host pack + device scan",
+        },
+        "session": {"replays": out["replays"],
+                    "frontier_capacity": out.get("frontier_capacity"),
+                    "segments": out["segments"]},
+        "compile_guard": g.summary(),
+    }
+    line = json.dumps(result)
+    print(line)
+    with open(args.json, "w") as fh:
+        fh.write(line + "\n")
+    assert flat, (
+        f"per-append cost grew with history: head4={head:.1f} ms "
+        f"tail4={tail:.1f} ms")
+    if compile_guard.enabled():
+        g.assert_closed()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -297,6 +297,76 @@ if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
     exit 1
 fi
 
+stage stream "streaming verification sessions smoke (kind:\"stream\")"
+# the live-history path end to end (docs/streaming.md): open a
+# session, append a clean delta (valid-so-far), append a violating
+# delta (INVALID latches — later appends answer immediately), close,
+# clean shutdown, no zombies
+ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+STRM_LOG=$(mktemp)
+JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
+    --backend cpu --no-prime --frontier 256 \
+    --max-sessions 4 >"$STRM_LOG" 2>&1 &
+STRM_PID=$!
+CLEANUP_PIDS="$STRM_PID"
+for _ in $(seq 200); do
+    grep -q '"ready"' "$STRM_LOG" 2>/dev/null && break
+    sleep 0.1
+done
+grep -q '"ready"' "$STRM_LOG" || { echo "stream daemon never became ready" >&2; \
+    cat "$STRM_LOG" >&2; exit 1; }
+STRM_LOG="$STRM_LOG" python - <<'EOF'
+import json, os
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.history import history_to_edn
+from comdb2_tpu.service.client import ServiceClient
+
+port = None
+with open(os.environ["STRM_LOG"]) as fh:
+    for line in fh:
+        if '"ready"' in line:
+            port = json.loads(line)["port"]
+            break
+assert port is not None, "no ready line in daemon log"
+c = ServiceClient("127.0.0.1", port, timeout_s=300.0, retries=5,
+                  backoff_s=0.5)
+r = c.stream_open()
+assert r.get("ok") and r.get("session"), r
+sid = r["session"]
+clean = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(1, "read", None), O.Op(1, "ok", "read", 1)]
+r = c.stream_append(sid, history_to_edn(clean))
+assert r.get("ok") and r.get("valid") is True, r
+assert r.get("checked_through") == 4, r
+bad = [O.invoke(1, "read", None), O.Op(1, "ok", "read", 9)]
+r = c.stream_append(sid, history_to_edn(bad))
+assert r.get("ok") and r.get("valid") is False, r
+# the latch: a third append answers immediately, no device work
+r = c.stream_append(sid, history_to_edn(clean))
+assert r.get("ok") and r.get("valid") is False and r.get("latched"), r
+r = c.stream_close(sid)
+assert r.get("ok") and r.get("valid") is False, r
+st = c.status()["status"]
+assert st["stream_opens"] >= 1 and st["stream_appends"] >= 3, st
+assert st["stream"]["sessions"] == 0, st
+m = c.metrics()
+assert "stream_sessions_active" in m["prometheus"]
+assert c.shutdown()
+EOF
+wait "$STRM_PID"
+CLEANUP_PIDS=""
+if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
+    echo "stream daemon left a process behind" >&2
+    exit 1
+fi
+ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
+if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+    echo "stream daemon left a zombie" \
+         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
+    exit 1
+fi
+run python scripts/bench_stream.py --quick --json /tmp/bench_stream_smoke.json
+
 stage routing "pmux-routed two-daemon fleet smoke"
 # the horizontal-scale path end to end: two daemons register under
 # ct_pmux (sut/verifier/0, sut/verifier/1), the consistent-hash

@@ -1,0 +1,32 @@
+"""Seeded violation: raw (unbucketed) shapes reach the streaming-
+session delta entrypoint — the ``stream_delta_chunk`` dispatch sink
+of the ``unbucketed-dispatch-site`` rule. A live history's alphabet
+grows as traffic arrives; raw memo counts here compile one program
+PER GROWTH STEP of every monitored session (the exact storm the
+``stream.engine.pad_sizes`` pow2 buckets exist to prevent). The raw
+``memo.n_states`` is laundered through a helper so only the
+interprocedural chase can tie the call site to the static shape
+argument."""
+
+from comdb2_tpu.checker import linear_jax as LJ
+from comdb2_tpu.stream.engine import stream_delta_chunk
+
+
+def _dispatch_delta(succ, ip, it, okp, dp, off, carry, n_states,
+                    n_transitions):
+    # the sink: the session rung's jit entry with static table dims
+    # taken from the caller's parameters
+    return stream_delta_chunk(
+        succ, ip, it, okp, dp, off, carry, F=256, Fs=32, P=4,
+        n_states=n_states, n_transitions=n_transitions)
+
+
+def append_all(session, deltas):
+    carry = LJ.init_seg_carry(256, 4)
+    for memo, (ip, it, okp, dp, off) in deltas:
+        # BUG: raw memo counts, no pad_sizes/next_pow2 — every append
+        # that grew the alphabet compiles a fresh program per session
+        carry = _dispatch_delta(session.succ_dev, ip, it, okp, dp,
+                                off, carry, memo.n_states,
+                                memo.n_transitions)
+    return carry
