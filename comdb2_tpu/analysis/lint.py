@@ -56,6 +56,16 @@ short story per rule id:
   off ONE clock, and a raw ``time.time()`` (wall clock, steppable by
   the clock nemesis) silently corrupts device-time attribution.
   ``comdb2_tpu/obs`` itself and tests are exempt.
+- ``host-numpy-checkpoint`` — session checkpoint/restore builders
+  must be HOST numpy only (the round-11 ``_host_seg_carry`` rule
+  generalized): a jnp-built checkpoint compiles infra programs
+  OUTSIDE the declared inventory (scatter/pad per carry shape —
+  one per session shape, per eviction), and eagerly round-trips the
+  tunnel. ``np.asarray`` of a device array is a readback, never a
+  compile; the restore upload rides the next delta dispatch's jit
+  transfer. Scope: the ``stream`` package plus any
+  "checkpoint"-named file (the fixture hook), functions whose name
+  contains ``checkpoint``/``restore``.
 """
 
 from __future__ import annotations
@@ -106,6 +116,11 @@ RAW_CLOCK_DIRS = {"service", "shrink", "txn", "stream"}
 RAW_CLOCK_FILES = {"linear.py", "batch.py", "pallas_seg.py"}
 RAW_CLOCK_FNS = {"time", "monotonic", "perf_counter"}
 
+#: substrings naming the checkpoint/restore builders the
+#: ``host-numpy-checkpoint`` rule audits (scope: the stream package
+#: + "checkpoint"-named files, so the seeded fixture is covered)
+CHECKPOINT_FN_PARTS = ("checkpoint", "restore")
+
 
 def _name_of(node: ast.AST) -> str:
     """Trailing identifier of a Name/Attribute chain ('' otherwise)."""
@@ -146,6 +161,8 @@ class _ModuleInfo(ast.NodeVisitor):
         self.ops_loops: List[int] = []
         self.vmap_oracle_calls: List[int] = []
         self.clock_calls: List[Tuple[int, str]] = []
+        self.jax_aliases: set = set()      # `import jax [as x]`
+        self.jnp_aliases: set = set()      # `import jax.numpy as jnp`
         self._time_modnames: set = set()   # `import time [as x]`
         self._time_aliases: set = set()    # `from time import ...`
         self._fn_depth = 0
@@ -168,6 +185,15 @@ class _ModuleInfo(ast.NodeVisitor):
                 self.imports_jax = True
                 if self._fn_depth == 0 and self.jax_import_line is None:
                     self.jax_import_line = node.lineno
+                if a.name == "jax":
+                    self.jax_aliases.add(a.asname or "jax")
+                elif a.name == "jax.numpy" and a.asname:
+                    self.jnp_aliases.add(a.asname)
+                elif not a.asname:
+                    # `import jax.numpy` (no asname) binds the NAME
+                    # `jax`: `jax.numpy.zeros(...)` must resolve
+                    # through the jax root like any other submodule
+                    self.jax_aliases.add("jax")
             if top == "multiprocessing":
                 self.mp_imports.append((node.lineno, a.name))
             if a.name == "time":
@@ -180,6 +206,15 @@ class _ModuleInfo(ast.NodeVisitor):
             self.imports_jax = True
             if self._fn_depth == 0 and self.jax_import_line is None:
                 self.jax_import_line = node.lineno
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.jnp_aliases.add(a.asname or "numpy")
+            elif node.module == "jax.numpy":
+                # `from jax.numpy import zeros` — any imported name
+                # is a device-op constructor inside a checkpoint
+                for a in node.names:
+                    self.jnp_aliases.add(a.asname or a.name)
         if top == "multiprocessing":
             self.mp_imports.append((node.lineno, node.module or top))
         if top == "concurrent":
@@ -384,6 +419,46 @@ def _dup_cond_findings(info: _ModuleInfo, path: str,
     return out
 
 
+def _checkpoint_findings(tree: ast.AST, info: _ModuleInfo,
+                         path: str) -> List[Finding]:
+    """``host-numpy-checkpoint``: device ops (jnp/jax attribute
+    chains, or names imported from jax.numpy) inside a function whose
+    name marks it a checkpoint/restore builder."""
+    bases = info.jax_aliases | info.jnp_aliases
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        name = node.name.lower()
+        if not any(p in name for p in CHECKPOINT_FN_PARTS):
+            continue
+        hits = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                root = sub
+                while isinstance(root.value, ast.Attribute):
+                    root = root.value
+                if isinstance(root.value, ast.Name) \
+                        and root.value.id in bases:
+                    hits.add(sub.lineno)
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Name) \
+                    and sub.func.id in info.jnp_aliases:
+                hits.add(sub.lineno)
+        for ln in sorted(hits):
+            out.append(Finding(
+                "host-numpy-checkpoint", path, ln,
+                f"jax/jnp op inside {node.name}() — checkpoint/"
+                "restore builders must be HOST numpy only: a "
+                "jnp-built snapshot compiles infra programs outside "
+                "the declared inventory (one per carry shape, per "
+                "eviction) and eagerly round-trips the tunnel; "
+                "np.asarray reads back, the next delta dispatch's "
+                "jit transfer uploads"))
+    return out
+
+
 def lint_file(path: str, source: Optional[str] = None, *,
               apply_suppressions: bool = True) -> List[Finding]:
     """All lint findings for one file (suppressions applied unless
@@ -493,6 +568,14 @@ def lint_file(path: str, source: Optional[str] = None, *,
                 "(monotonic()/span()): stage sums only tile the "
                 "measured wall when every timestamp shares ONE "
                 "monotonic clock (docs/observability.md)"))
+
+    # checkpoint/restore scope: the stream package (where the session
+    # snapshot path lives) + any "checkpoint"-named file (fixture
+    # hook); tests may build whatever debug snapshots they like
+    if not in_tests and ("checkpoint" in base
+                         or ("stream" in parts
+                             and "comdb2_tpu" in parts)):
+        raw += _checkpoint_findings(tree, info, path)
 
     if base in PACK_SEGMENT_MODULES or "pack" in base:
         for ln in info.ops_loops:

@@ -154,6 +154,13 @@ class IncrementalMemo:
         #: bumped whenever the table content changes — device-side
         #: copies (stream sessions) key their upload cache on it
         self.version = 0
+        #: the extend-call log, replayed verbatim by checkpoint
+        #: restore: state NUMBERING is extension-order-dependent and
+        #: the device carries store state ids, so a restored memo must
+        #: re-run the SAME extension sequence (a one-shot re-memoization
+        #: would renumber and silently corrupt every resident config).
+        #: O(distinct transitions), never O(history).
+        self._log: List[Tuple[Tuple[Tuple[Any, Any], ...], int]] = []
 
     @property
     def n_states(self) -> int:
@@ -206,6 +213,24 @@ class IncrementalMemo:
             self._depths[sid] = depth
             work.append(sid)
         return sid
+
+    def checkpoint(self) -> dict:
+        """Everything :meth:`restore` needs to rebuild this memo with
+        IDENTICAL state numbering: the extend-call log (plus the cap).
+        The states themselves are re-derived by replay — host data
+        only, O(distinct transitions), never O(history)."""
+        return {"max_states": self.max_states,
+                "log": [(tuple(tr), d) for tr, d in self._log]}
+
+    @classmethod
+    def restore(cls, model: Model, ck: dict) -> "IncrementalMemo":
+        """Replay the extend log onto a fresh memo — deterministic, so
+        state ids (and therefore every id a device carry stores) come
+        back bit-identical."""
+        memo = cls(model, max_states=int(ck["max_states"]))
+        for tr, d in ck["log"]:
+            memo.extend([tuple(t) for t in tr], int(d))
+        return memo
 
     def extend(self, transitions: List[Tuple[Any, Any]],
                max_depth: int) -> None:
@@ -262,6 +287,13 @@ class IncrementalMemo:
                 row.append(-1 if m2 is None
                            else self._intern(m2, d + 1, work))
             self._rows[sid] = row
+        # log AFTER the closure succeeds: an extend that raises
+        # MemoOverflow latches the session terminal-UNKNOWN but the
+        # session stays checkpointable — a log entry for the failed
+        # call would make every restore of that checkpoint replay the
+        # overflow and raise, turning the latched verdict into a
+        # spurious error (and losing a released migration outright)
+        self._log.append((tuple(transitions), self.max_depth))
 
 
 def memo(model: Model, packed: PackedHistory,

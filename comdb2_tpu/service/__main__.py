@@ -98,7 +98,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "retry_after_ms)")
     p.add_argument("--session-idle-s", type=float, default=300.0,
                    help="idle TTL before a streaming session's carry "
-                        "is evicted (clients re-open by replay)")
+                        "is checkpointed to host and evicted (the "
+                        "next verb restores it with zero replay)")
+    p.add_argument("--drain-s", type=float, default=10.0,
+                   help="drain grace: after SIGTERM or kind:\"drain\" "
+                        "the daemon deregisters from pmux, re-routes "
+                        "queued work, finalizes staged dispatches, "
+                        "and keeps serving session-checkpoint "
+                        "handoffs this long before exiting")
     p.add_argument("--no-prime", action="store_true",
                    help="skip compile-cache warm-start at boot")
     p.add_argument("--interpret", action="store_true",
@@ -163,8 +170,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     daemon = VerifierDaemon(core, host=args.host, port=args.port,
                             pmux_port=args.pmux,
                             pmux_service=pmux_service,
-                            store_root=args.store)
-    signal.signal(signal.SIGTERM, daemon.stop)
+                            store_root=args.store,
+                            drain_grace_s=args.drain_s)
+    # SIGTERM = graceful leave (deregister BEFORE the listener closes,
+    # re-route queued work, serve checkpoint handoffs through the
+    # grace window); SIGINT stays the immediate stop
+    signal.signal(signal.SIGTERM, daemon.drain)
     signal.signal(signal.SIGINT, daemon.stop)
     primed = 0
     if not args.no_prime:

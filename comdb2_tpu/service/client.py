@@ -36,11 +36,27 @@ from .daemon import PMUX_SERVICE
 
 class ServiceError(Exception):
     """The daemon answered ``ok: false`` (``.code`` holds the error
-    code, e.g. ``"overload"``)."""
+    code, e.g. ``"overload"``; ``.retry_after_ms`` the backoff hint
+    when the reply carried one — the routed failover honors it
+    per node)."""
 
-    def __init__(self, code: str, message: str = ""):
+    def __init__(self, code: str, message: str = "",
+                 retry_after_ms: Optional[float] = None):
         super().__init__(f"{code}: {message}" if message else code)
         self.code = code
+        self.retry_after_ms = retry_after_ms
+
+    @classmethod
+    def from_reply(cls, reply: dict) -> "ServiceError":
+        return cls(reply.get("error", "unknown-error"),
+                   reply.get("message", ""),
+                   reply.get("retry_after_ms"))
+
+
+def _checked(reply: dict, raise_on_error: bool) -> dict:
+    if raise_on_error and not reply.get("ok"):
+        raise ServiceError.from_reply(reply)
+    return reply
 
 
 class ServiceClient:
@@ -178,10 +194,7 @@ class ServiceClient:
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
         reply = self._request_shedding(req)
-        if raise_on_error and not reply.get("ok"):
-            raise ServiceError(reply.get("error", "unknown-error"),
-                               reply.get("message", ""))
-        return reply
+        return _checked(reply, raise_on_error)
 
     def shrink(self, history: Union[str, List, None] = None, *,
                model: Optional[str] = None, keyed: bool = False,
@@ -210,23 +223,26 @@ class ServiceClient:
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
         reply = self._request_shedding(req)
-        if raise_on_error and not reply.get("ok"):
-            raise ServiceError(reply.get("error", "unknown-error"),
-                               reply.get("message", ""))
-        return reply
+        return _checked(reply, raise_on_error)
 
     # -- streaming sessions (kind:"stream", docs/streaming.md) ---------
 
     def stream_open(self, *, model: Optional[str] = None,
                     keyed: bool = False, rung: Optional[str] = None,
+                    checkpoint: Optional[dict] = None,
                     raise_on_error: bool = True) -> dict:
         """Open a streaming session; the reply carries ``session``
         (the id every later verb names). An ``overload`` reply means
         the daemon's session table is at cap — back off on its
-        ``retry_after_ms`` like any other overload."""
+        ``retry_after_ms`` like any other overload. ``checkpoint``
+        (a wire checkpoint from :meth:`stream_checkpoint`) opens BY
+        RESTORE — the migration handoff's receiving half; model/rung
+        ride inside the checkpoint and are ignored."""
         self._seq += 1
         req: dict = {"op": "check", "id": self._seq,
                      "kind": "stream", "verb": "open"}
+        if checkpoint is not None:
+            req["checkpoint"] = checkpoint
         if model is not None:
             req["model"] = model
         if keyed:
@@ -234,10 +250,33 @@ class ServiceClient:
         if rung is not None:
             req["rung"] = rung
         reply = self._request_shedding(req)
-        if raise_on_error and not reply.get("ok"):
-            raise ServiceError(reply.get("error", "unknown-error"),
-                               reply.get("message", ""))
-        return reply
+        return _checked(reply, raise_on_error)
+
+    def stream_checkpoint(self, session: str, *,
+                          release: bool = False,
+                          raise_on_error: bool = True) -> dict:
+        """Fetch a session's host-numpy checkpoint (wire form, in
+        ``checkpoint``; ``checkpoint_bytes`` its size).
+        ``release=True`` is the migration form: the daemon REMOVES
+        the session (a handoff moves, never copies — two daemons
+        serving one session would double-serve its appends)."""
+        self._seq += 1
+        req: dict = {"op": "check", "id": self._seq,
+                     "kind": "stream", "verb": "checkpoint",
+                     "session": session}
+        if release:
+            req["release"] = True
+        return _checked(self._request(req), raise_on_error)
+
+    def drain(self, raise_on_error: bool = True) -> dict:
+        """``kind:"drain"``: ask the daemon to leave gracefully —
+        deregister, re-route queued work, finalize staged dispatches,
+        serve session-checkpoint handoffs through its grace window,
+        exit. The reply reports what was flushed/resident."""
+        self._seq += 1
+        return _checked(self._request({"op": "check", "kind": "drain",
+                                       "id": self._seq}),
+                        raise_on_error)
 
     def stream_append(self, session: str,
                       history: Union[str, List, None], *,
@@ -255,10 +294,7 @@ class ServiceClient:
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
         reply = self._request_shedding(req)
-        if raise_on_error and not reply.get("ok"):
-            raise ServiceError(reply.get("error", "unknown-error"),
-                               reply.get("message", ""))
-        return reply
+        return _checked(reply, raise_on_error)
 
     def stream_poll(self, session: str,
                     raise_on_error: bool = True) -> dict:
@@ -266,10 +302,7 @@ class ServiceClient:
         reply = self._request({"op": "check", "id": self._seq,
                                "kind": "stream", "verb": "poll",
                                "session": session})
-        if raise_on_error and not reply.get("ok"):
-            raise ServiceError(reply.get("error", "unknown-error"),
-                               reply.get("message", ""))
-        return reply
+        return _checked(reply, raise_on_error)
 
     def stream_close(self, session: str,
                      raise_on_error: bool = True) -> dict:
@@ -279,10 +312,7 @@ class ServiceClient:
         reply = self._request({"op": "check", "id": self._seq,
                                "kind": "stream", "verb": "close",
                                "session": session})
-        if raise_on_error and not reply.get("ok"):
-            raise ServiceError(reply.get("error", "unknown-error"),
-                               reply.get("message", ""))
-        return reply
+        return _checked(reply, raise_on_error)
 
     def status(self) -> dict:
         return self._request({"op": "status"})
@@ -370,13 +400,38 @@ class RoutedClient:
     audits."""
 
     def __init__(self, endpoints: Dict[str, ServiceClient],
-                 replicas: int = 64):
+                 replicas: int = 64, blacklist_ttl_s: float = 3.0,
+                 epoch_poll_s: float = 1.0):
         if not endpoints:
             raise ValueError("RoutedClient needs >= 1 endpoint")
         self.clients = dict(endpoints)
+        self.replicas = replicas
         self.ring = HashRing(list(endpoints), replicas=replicas)
         self.served: Dict[str, int] = {n: 0 for n in endpoints}
         self.failovers = 0
+        self.refreshes = 0
+        self.migrations = 0
+        #: dead-node blacklist TTL: a node that failed a connect/IO
+        #: is skipped on ring walks until the TTL expires, instead of
+        #: paying a connect timeout on EVERY request that hashes near
+        #: it; overload/drain replies park the node until the
+        #: daemon's own retry_after_ms hint
+        self.blacklist_ttl_s = float(blacklist_ttl_s)
+        #: how often (at most) a routed call polls the single pmux
+        #: epoch entry; failures force a poll immediately
+        self.epoch_poll_s = float(epoch_poll_s)
+        self._avoid: Dict[str, float] = {}   # node -> not-before
+        self.epoch: Optional[int] = None     # ring version last seen
+        self._epoch_checked = float("-inf")
+        self._disco: Optional[tuple] = None  # (host, port, prefix, kw)
+        #: a draining daemon deregisters FIRST and then serves session
+        #: checkpoint handoffs on its ALREADY-OPEN connections only
+        #: (the listener is closed) — so when a refresh drops a node
+        #: that still has streams pinned to it, the warm client parks
+        #: here instead of closing, or the O(carry) migration window
+        #: would be destroyed by any unrelated routed request
+        self._parting: Dict[str, ServiceClient] = {}
+        self._pins: Dict[str, int] = {}      # node -> open streams
 
     @classmethod
     def discover(cls, pmux_port: int = 5105,
@@ -385,9 +440,20 @@ class RoutedClient:
         """Build the fleet from ct_pmux: every registration named
         ``<prefix>`` or ``<prefix>/<shard>`` joins the ring (the
         ``--pmux-shard`` daemons). Raises when none is registered —
-        an empty fleet is an operations failure, not an empty ring."""
+        an empty fleet is an operations failure, not an empty ring.
+        The discovery parameters are retained: the client later
+        REFRESHES the ring whenever the fleet's ring-version epoch
+        bumps (a daemon joined or left), remapping ~1/N of the shape
+        classes instead of ever serving from a stale membership."""
         from ..control.pmux import PmuxClient
+        from .daemon import epoch_service_for
 
+        # overload handling belongs to the ROUTED layer here: a node
+        # answering overload is parked for its own retry_after_ms and
+        # the walk moves on — the per-node client must not sleep-and-
+        # re-dial the same overloaded daemon first (callers can still
+        # opt back in explicitly)
+        kw.setdefault("overload_retries", 0)
         with PmuxClient(host, pmux_port) as c:
             used = c.used()
         endpoints = {
@@ -398,19 +464,175 @@ class RoutedClient:
             raise OSError(
                 f"pmux at {host}:{pmux_port} knows no {prefix!r} "
                 "daemons")
-        return cls(endpoints)
+        rc = cls(endpoints)
+        rc._disco = (host, pmux_port, prefix, dict(kw))
+        rc.epoch = used.get(epoch_service_for(prefix))
+        rc._epoch_checked = _monotonic()
+        return rc
 
-    def _route(self, key: Union[str, bytes], fn):
+    # -- live membership (epochs) --------------------------------------
+
+    def refresh(self) -> tuple:
+        """Re-read the registry and rebuild the ring: new daemons
+        join (their ~1/N of the classes remap onto them), departed
+        ones leave (their classes remap onto survivors), surviving
+        names keep their ServiceClient (warm connection). Returns
+        ``(added, removed)`` name lists; a no-op without discovery
+        parameters (statically-built clients)."""
+        if self._disco is None:
+            return [], []
+        host, pmux_port, prefix, kw = self._disco
+        from ..control.pmux import PmuxClient
+        from .daemon import epoch_service_for
+
+        with PmuxClient(host, pmux_port) as c:
+            used = c.used()
+        self.epoch = used.get(epoch_service_for(prefix))
+        names = {svc: port for svc, port in used.items()
+                 if svc == prefix or svc.startswith(prefix + "/")}
+        if not names:
+            # an empty registry mid-flight: keep serving on the
+            # current ring — a stale ring beats no ring, and the
+            # blacklist already shields dead nodes
+            return [], []
+        added = sorted(n for n in names if n not in self.clients)
+        removed = sorted(n for n in self.clients if n not in names)
+        for n in added:
+            self.clients[n] = ServiceClient(host, names[n], **kw)
+            self.served.setdefault(n, 0)
+            self._avoid.pop(n, None)
+        for n in removed:
+            c = self.clients.pop(n)
+            if self._pins.get(n):
+                self._parting[n] = c     # pinned: see __init__ note
+            else:
+                c.close()
+        repaired = 0
+        for n, port in names.items():
+            c = self.clients[n]
+            if c.port != port:           # same name, restarted daemon
+                c.close()
+                c.port = port
+                self._avoid.pop(n, None)
+                repaired += 1
+        self.ring = HashRing(list(self.clients),
+                             replicas=self.replicas)
+        if added or removed or repaired:
+            self.refreshes += 1
+        return added, removed
+
+    def maybe_refresh(self, force: bool = False) -> bool:
+        """Cheap membership check: ONE pmux ``get`` of the epoch
+        entry, rate-limited to ``epoch_poll_s`` (every request pays a
+        dict lookup, not a registry listing); a changed epoch
+        triggers a full :meth:`refresh`. ``force`` skips the rate
+        limit — failure paths call it so a dead/drained node is
+        replaced on the spot."""
+        if self._disco is None:
+            return False
+        now = _monotonic()
+        if not force and now - self._epoch_checked < self.epoch_poll_s:
+            return False
+        self._epoch_checked = now
+        host, pmux_port, prefix, _kw = self._disco
+        from ..control.pmux import PmuxClient
+        from .daemon import epoch_service_for
+
+        try:
+            with PmuxClient(host, pmux_port) as c:
+                e = c.get(epoch_service_for(prefix))
+        except OSError:
+            return False
+        if e is None or e == self.epoch:
+            return False
+        try:
+            self.refresh()
+        except OSError:
+            return False
+        # any epoch movement counts as "changed" for the caller's
+        # retry decision: a refresh may have repaired a restarted
+        # daemon's PORT without touching the name set, and the retry
+        # must run against the repaired client either way
+        return True
+
+    # -- stream pins ---------------------------------------------------
+
+    def _pin(self, name: str) -> None:
+        self._pins[name] = self._pins.get(name, 0) + 1
+
+    def _unpin(self, name: str) -> None:
+        n = self._pins.get(name, 0) - 1
+        if n > 0:
+            self._pins[name] = n
+            return
+        self._pins.pop(name, None)
+        c = self._parting.pop(name, None)
+        if c is not None:
+            c.close()
+
+    # -- the ring walk -------------------------------------------------
+
+    def _route(self, key: Union[str, bytes], fn, _retry: bool = True):
+        """Walk the ring from the key's owner: blacklisted nodes
+        (dead within TTL, overloaded within their own retry_after_ms
+        hint, draining) are skipped — never re-dialed hot; a node
+        that fails here is parked and the walk continues. When the
+        whole walk fails, one forced membership refresh retries the
+        walk once (the fleet may have changed under us)."""
+        self.maybe_refresh()
+        now = _monotonic()
+        chain = self.ring.nodes_for(key)
+        live = [n for n in chain if self._avoid.get(n, 0.0) <= now]
         last: Optional[Exception] = None
-        for name in self.ring.nodes_for(key):
+        for name in (live or chain):
+            # all-parked falls through to the raw chain: trying a
+            # blacklisted node beats refusing the request outright
+            c = self.clients.get(name)
+            if c is None:
+                continue
             try:
-                out = fn(self.clients[name])
+                out = fn(c)
             except OSError as e:
                 last = e
                 self.failovers += 1
+                # timestamp AFTER the failure: a hung connect burns
+                # its timeout before raising, and a TTL anchored at
+                # walk start would already be expired when written
+                self._avoid[name] = _monotonic() + self.blacklist_ttl_s
                 continue
+            except ServiceError as e:
+                if e.code == protocol.OVERLOAD:
+                    # honor the node's own backpressure hint during
+                    # failover: park it for retry_after_ms and try
+                    # the next ring node (only the happy path backed
+                    # off before)
+                    ra = e.retry_after_ms
+                    if not isinstance(ra, (int, float)) or ra <= 0:
+                        ra = 100.0
+                    self._avoid[name] = _monotonic() + float(ra) / 1e3
+                    self.failovers += 1
+                    last = e
+                    continue
+                if e.code == protocol.SHUTDOWN:
+                    # draining daemon: it already deregistered AND
+                    # bumped the epoch before this reply — park it
+                    # and force the membership check now, restarting
+                    # the walk on the refreshed ring instead of
+                    # burning a hop on it per walk until the poll
+                    self._avoid[name] = (_monotonic()
+                                         + self.blacklist_ttl_s)
+                    self.failovers += 1
+                    last = e
+                    if _retry and self.maybe_refresh(force=True):
+                        return self._route(key, fn, _retry=False)
+                    continue
+                raise
             self.served[name] += 1
             return out
+        if _retry and self.maybe_refresh(force=True):
+            return self._route(key, fn, _retry=False)
+        if isinstance(last, ServiceError):
+            raise last
         raise OSError(f"every daemon on the ring failed: {last}")
 
     @staticmethod
@@ -470,6 +692,9 @@ class RoutedClient:
     def close(self) -> None:
         for c in self.clients.values():
             c.close()
+        for c in self._parting.values():
+            c.close()
+        self._parting.clear()
 
     def __enter__(self) -> "RoutedClient":
         return self
@@ -494,19 +719,41 @@ class RoutedStream:
         self.rung = rung
         self._deltas: List[str] = []
         self.failovers = 0
+        self.migrations = 0
         self.node: Optional[str] = None
         self.session: Optional[str] = None
+        self._closed = False
         self._open_somewhere(
             routed.ring.nodes_for(f"stream|{model or ''}|"
                                   f"{id(self):x}"))
 
-    def _open_somewhere(self, chain) -> None:
+    def _client(self) -> ServiceClient:
+        # prefer the PARTING table: a draining daemon is reachable
+        # only over this retained warm connection (its listener is
+        # closed), and if the same shard name has re-registered, the
+        # fresh client in ``clients`` is a NEW process that does not
+        # hold this session's carry
+        c = (self.routed._parting.get(self.node)
+             or self.routed.clients.get(self.node))
+        if c is None:
+            # the pinned daemon left the ring under a refresh
+            raise OSError(f"session node {self.node!r} left the ring")
+        return c
+
+    def _open_somewhere(self, chain,
+                        checkpoint: Optional[dict] = None) -> None:
         last: Optional[Exception] = None
         for name in chain:
+            c = self.routed.clients.get(name)
+            if c is None:
+                continue
             try:
-                r = self.routed.clients[name].stream_open(
-                    model=self.model, keyed=self.keyed,
-                    rung=self.rung)
+                r = c.stream_open(model=self.model, keyed=self.keyed,
+                                  rung=self.rung,
+                                  checkpoint=checkpoint)
+                if self.node is not None:
+                    self.routed._unpin(self.node)
+                self.routed._pin(name)
                 self.node = name
                 self.session = r["session"]
                 self.routed.served[name] = \
@@ -516,9 +763,40 @@ class RoutedStream:
                 last = e
         raise OSError(f"no daemon would open a stream session: {last}")
 
+    def _migrate(self) -> bool:
+        """The drain/leave handoff (docs/streaming.md "Checkpoint /
+        migration"): fetch-AND-RELEASE the session's checkpoint from
+        the departing daemon, re-open from it on the next ring node —
+        O(carry) over the wire, zero device replay, dispatch count
+        stays O(delta) afterward. Returns False when the old daemon
+        can't serve the handoff (already dead) — the caller then
+        falls back to retained-delta replay."""
+        old = self.node
+        try:
+            r = self._client().stream_checkpoint(
+                self.session, release=True, raise_on_error=False)
+        except OSError:
+            return False
+        ck = r.get("checkpoint") if r.get("ok") else None
+        if ck is None:
+            return False
+        self.routed.maybe_refresh(force=True)
+        chain = [n for n in self.routed.ring.nodes_for(
+            f"stream|{self.model or ''}|{id(self):x}")
+            if n != old] or [n for n in self.routed.clients
+                             if n != old]
+        try:
+            self._open_somewhere(chain, checkpoint=ck)
+        except OSError:
+            return False
+        self.migrations += 1
+        self.routed.migrations += 1
+        return True
+
     def _failover(self) -> None:
         self.failovers += 1
         self.routed.failovers += 1
+        self.routed.maybe_refresh(force=True)
         chain = [n for n in self.routed.ring.nodes_for(
             f"stream|{self.model or ''}|{id(self):x}")
             if n != self.node] or list(self.routed.clients)
@@ -538,7 +816,7 @@ class RoutedStream:
 
     def _pinned(self, fn, retried: bool = False):
         try:
-            return fn(self.routed.clients[self.node])
+            return fn(self._client())
         except OSError:
             if retried:
                 raise
@@ -551,10 +829,20 @@ class RoutedStream:
             lambda c: c.stream_append(self.session, text,
                                       raise_on_error=False, **kw))
         if (not out.get("ok")
+                and out.get("error") == protocol.SHUTDOWN):
+            # the pinned daemon is draining: hand the session off by
+            # checkpoint (O(carry)); only a daemon too dead to serve
+            # the handoff costs the full retained-delta replay
+            if not self._migrate():
+                self._failover()
+            out = self._pinned(
+                lambda c: c.stream_append(self.session, text,
+                                          raise_on_error=False, **kw))
+        if (not out.get("ok")
                 and out.get("error") == protocol.BAD_REQUEST
                 and "unknown session" in out.get("message", "")):
-            # idle-evicted on a live daemon: same replay path as a
-            # dead node
+            # aged fully out (checkpoint bound) on a live daemon:
+            # same replay path as a dead node
             self._failover()
             out = self._pinned(
                 lambda c: c.stream_append(self.session, text,
@@ -573,10 +861,19 @@ class RoutedStream:
                                     raise_on_error=False))
 
     def close(self) -> dict:
-        out = self._pinned(
-            lambda c: c.stream_close(self.session,
-                                     raise_on_error=False))
-        self._deltas = []
+        try:
+            out = self._pinned(
+                lambda c: c.stream_close(self.session,
+                                         raise_on_error=False))
+        finally:
+            # unpin even when the close request itself fails (dead
+            # daemon, failover exhausted) — a leaked pin would park
+            # the node's client in _parting forever on the next
+            # refresh, with no remaining path that closes it
+            self._deltas = []
+            if not self._closed and self.node is not None:
+                self.routed._unpin(self.node)
+                self._closed = True
         return out
 
 

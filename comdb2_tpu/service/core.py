@@ -215,6 +215,17 @@ class VerifierCore:
 
         self.sessions = SessionManager(max_sessions=max_sessions,
                                        idle_s=session_idle_s)
+        #: drain mode (round 12, docs/service.md "Elastic fleet"): a
+        #: draining core re-routes forming batches (queued requests
+        #: answer ``shutting-down``), finalizes staged dispatches
+        #: normally, sheds NEW work, and keeps serving the session
+        #: handoff verbs (checkpoint/poll/close) + metrics/status
+        self.draining = False
+        #: the fleet ring version this daemon last registered under
+        #: (``sut/verifier.epoch`` in pmux; the daemon sets it) —
+        #: scraped as the ``ring_epoch`` gauge so a fleet-wide scrape
+        #: shows membership convergence
+        self.ring_epoch = 0
         self.t_boot = obs.monotonic()
         # continuous-batching admission state
         self._slots: Dict[tuple, _Slot] = {}
@@ -270,6 +281,11 @@ class VerifierCore:
             # evictions (docs/streaming.md)
             "stream_opens": 0, "stream_appends": 0,
             "stream_closes": 0, "stream_evicted": 0,
+            # elastic fleet (round 12): checkpoint handoffs out
+            # (verb:"checkpoint"), migrated sessions admitted in
+            # (open-with-checkpoint), drain entries
+            "stream_checkpoints": 0, "stream_migrations": 0,
+            "drains": 0,
         }
         self._g_sessions = self.metrics.gauge(
             "stream_sessions_active",
@@ -277,6 +293,20 @@ class VerifierCore:
         self._g_carry_bytes = self.metrics.gauge(
             "stream_carry_resident_bytes",
             help="device bytes held by resident session carries")
+        # elastic-fleet plane (docs/service.md "Elastic fleet"):
+        # membership + migration visibility in every scrape
+        self._g_epoch = self.metrics.gauge(
+            "ring_epoch",
+            help="fleet ring version this daemon last registered "
+                 "under (bumped by every pmux join/leave)")
+        self._c_migrations = self.metrics.counter(
+            "stream_migrations",
+            help="sessions admitted from a checkpoint handoff "
+                 "(open-with-checkpoint)")
+        self._c_ck_bytes = self.metrics.counter(
+            "checkpoint_bytes",
+            help="cumulative wire bytes of session checkpoints "
+                 "handed off or admitted")
 
     # -- admission queue views -----------------------------------------
 
@@ -334,6 +364,17 @@ class VerifierCore:
             # plane must work exactly when the queue is full — it
             # never queues, never dispatches
             return None, self.metrics_reply(rid)
+        if req.get("kind") == "drain":
+            return None, self._drain_verb(rid, now)
+        if self.draining and not self._drain_serves(req):
+            # a draining daemon re-routes instead of queueing: the
+            # client's ring walk treats shutting-down like a dead
+            # node and fails over — "forming batches re-route"
+            out = protocol.error_reply(
+                protocol.SHUTDOWN,
+                "daemon is draining — re-route to the fleet", rid)
+            out["draining"] = True
+            return None, out
         if self.queue_depth() >= self.max_queue:
             # backpressure BEFORE parse: shedding load must stay O(1)
             # — and before the kind split, so txn requests answer
@@ -697,6 +738,13 @@ class VerifierCore:
         from ..stream.manager import SessionLimit
 
         verb = req.get("verb", "append")
+        if verb == "open" and req.get("checkpoint") is not None:
+            # open-with-checkpoint: the migration handoff's second
+            # half (docs/streaming.md "Checkpoint / migration") — a
+            # session drained off another daemon resumes HERE with
+            # its carry bits intact, zero replay
+            return self._stream_open_restored(req["checkpoint"], now,
+                                              rid)
         if verb == "open":
             model = req.get("model") or self.model
             from ..models.model import MODELS
@@ -727,6 +775,12 @@ class VerifierCore:
             return None, self._reply(rid, True, kind="stream",
                                      session=sid, model=model)
         sid = req.get("session")
+        if verb == "checkpoint":
+            # resolved BEFORE the transparent-restore get(): an
+            # idle-evicted session's held host snapshot is the
+            # requested artifact — restoring it just to re-snapshot
+            # would replay the memo extend log on the drain path
+            return None, self._stream_checkpoint(sid, req, rid)
         s = self.sessions.get(sid, now)
         if s is None:
             self.m["bad_requests"] += 1
@@ -781,6 +835,80 @@ class VerifierCore:
         self._bstats(pending.bucket.key).requests += 1
         self._slot_add(pending, now)
         return pending, None
+
+    def _stream_open_restored(self, ck_wire, now: float, rid):
+        """Admit one migrated session from its wire checkpoint."""
+        from ..stream import checkpoint as CKPT
+        from ..stream.manager import SessionLimit
+
+        try:
+            ck = CKPT.from_wire(ck_wire)
+            nbytes = CKPT.wire_nbytes(ck_wire)
+        except Exception as e:              # noqa: BLE001 — client data
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"undecodable checkpoint: {e}",
+                rid)
+        try:
+            sid, s = self.sessions.open_restored(now, ck)
+        except SessionLimit as e:
+            self.m["overloads"] += 1
+            self._event("overload", now)
+            ra = self._retry_after_ms(now)
+            out = protocol.error_reply(
+                protocol.OVERLOAD, f"{e}; retry in ~{ra} ms", rid)
+            out["retry_after_ms"] = ra
+            return None, out
+        except (ValueError, KeyError, TypeError) as e:
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unrestorable checkpoint: {e}",
+                rid)
+        self.m["stream_opens"] += 1
+        self.m["stream_migrations"] += 1
+        self._c_migrations.inc()
+        self._c_ck_bytes.inc(nbytes)
+        out = self._stream_reply(rid, sid, s.poll())
+        out["migrated"] = True
+        out["checkpoint_bytes"] = nbytes
+        return None, out
+
+    def _stream_checkpoint(self, sid, req: dict, rid) -> dict:
+        """``verb:"checkpoint"``: snapshot a session for handoff.
+        ``release:true`` (the migration form) removes it — a handoff
+        MOVES the session; both daemons serving it would double-serve
+        its appends."""
+        from ..stream import checkpoint as CKPT
+
+        release = bool(req.get("release"))
+        ck = self.sessions.checkpoint(sid)
+        if ck is None:
+            self.m["bad_requests"] += 1
+            return protocol.error_reply(
+                protocol.BAD_REQUEST, f"unknown session {sid!r}", rid)
+        try:
+            wire = CKPT.to_wire(ck)
+            nbytes = CKPT.wire_nbytes(wire)
+        except Exception as e:              # noqa: BLE001
+            # encode failed: the session MUST survive — releasing
+            # first would complete the MOVE's destructive half with
+            # the checkpoint never delivered
+            self.m["engine_errors"] += 1
+            return protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"checkpoint not wire-encodable: "
+                f"{type(e).__name__}: {e}", rid)
+        if release:
+            # the snapshot is encoded and about to ship: complete the
+            # move (both daemons serving one session would
+            # double-serve its appends)
+            self.sessions.drop(sid)
+        self.m["stream_checkpoints"] += 1
+        self._c_ck_bytes.inc(nbytes)
+        out = self._reply(rid, ck["valid"], kind="stream",
+                          session=sid, checkpoint=wire,
+                          checkpoint_bytes=nbytes, released=release)
+        return out
 
     def _stream_reply(self, rid, sid, verdict: dict) -> dict:
         out = self._reply(rid, verdict.get("valid"), kind="stream",
@@ -869,6 +997,64 @@ class VerifierCore:
 
         return finish
 
+    # -- drain (elastic fleet, docs/service.md) ------------------------
+
+    @staticmethod
+    def _drain_serves(req: dict) -> bool:
+        """What a draining core still answers: the session-handoff
+        verbs (a departing daemon's whole point is letting clients
+        pull their sessions out), plus poll/close. Everything else —
+        new checks, txn, shrink, stream open/append — re-routes."""
+        if req.get("kind") != "stream":
+            return False
+        return req.get("verb") in ("checkpoint", "poll", "close")
+
+    def _drain_verb(self, rid, now: float) -> dict:
+        """``kind:"drain"``: enter drain mode and report what's left.
+        Idempotent — supervisors and SIGTERM both land here."""
+        flushed = self.begin_drain(now)
+        return {"ok": True, "kind": "drain", "draining": True,
+                "flushed": flushed, "inflight": len(self._ring),
+                "sessions": len(self.sessions),
+                **({"id": rid} if rid is not None else {})}
+
+    def begin_drain(self, now: float) -> int:
+        """Enter drain: every QUEUED (not yet staged) request answers
+        ``shutting-down`` so its client re-routes to the fleet —
+        re-queueing them here would race the socket close. Staged
+        dispatches in the in-flight ring are NOT touched: they
+        finalize and reply normally (the pump drains the ring fully
+        while draining). Sessions stay resident for checkpoint
+        handoff. Returns the number of re-routed requests."""
+        if not self.draining:
+            self.draining = True
+            self.m["drains"] += 1
+            self._event("drain", now)
+        flushed = 0
+        for p in list(self._pending()):
+            out = protocol.error_reply(
+                protocol.SHUTDOWN,
+                "daemon is draining — re-route to the fleet", p.rid)
+            out["draining"] = True
+            if p.kind == "stream":
+                # the delta was never ingested; the session (and its
+                # retained deltas client-side) are unchanged
+                out["session"] = p.packed[0]
+            self._finish(p, out, self._done)
+            flushed += 1
+        for slot in self._slots.values():
+            slot.items = []
+            slot.t_launch = float("inf")
+        self._hosts.clear()
+        self._jobs.clear()
+        return flushed
+
+    def drained(self) -> bool:
+        """Nothing queued, nothing staged — the daemon may close once
+        sessions are handed off (or its drain grace expires)."""
+        return (self.draining and self.queue_depth() == 0
+                and not self._ring)
+
     # -- the scheduler beat --------------------------------------------
 
     def pump(self, now: Optional[float] = None, idle: bool = False):
@@ -904,7 +1090,7 @@ class VerifierCore:
             else:
                 self._host_check(p, self._done)
         self._step_shrinks()
-        if idle:
+        if idle or self.draining:
             self._ring_drain()
         elif self._ring and not any(s.items
                                     for s in self._slots.values()):
@@ -1522,6 +1708,13 @@ class VerifierCore:
         self._g_ring.set(len(self._ring))
         self._g_sessions.set(len(self.sessions))
         self._g_carry_bytes.set(self.sessions.carry_bytes())
+        self._g_epoch.set(self.ring_epoch)
+        m.gauge(
+            "stream_checkpoints_held",
+            help="evicted sessions resumable from a host checkpoint"
+        ).set(self.sessions.checkpoint_count())
+        m.counter("service_stream_restores_total").value = \
+            self.sessions.restores
         for k, v in self.m.items():
             m.counter(f"service_{k}_total").value = v
         for key, bs in self._buckets.items():
@@ -1589,11 +1782,15 @@ class VerifierCore:
             "ring_depth": self.ring_depth,
             "fill_window_ms": round(self.fill_window_s * 1e3, 3),
             "carry_reuses": PS.CARRY_REUSES,
+            "draining": self.draining,
+            "ring_epoch": self.ring_epoch,
             "stream": {
                 "sessions": len(self.sessions),
                 "max_sessions": self.sessions.max_sessions,
                 "carry_bytes": self.sessions.carry_bytes(),
                 "idle_s": self.sessions.idle_s,
+                "checkpoints_held": self.sessions.checkpoint_count(),
+                "restores": self.sessions.restores,
             },
             "model": self.model,
             "engine": self.engine,

@@ -47,6 +47,39 @@ logger = logging.getLogger(__name__)
 PMUX_SERVICE = "sut/verifier"
 
 
+def epoch_service_for(service: str) -> str:
+    """The fleet's ring-version entry in pmux, derived from a daemon's
+    service name: ``sut/verifier`` and every ``sut/verifier/<shard>``
+    share ``sut/verifier.epoch``. A ``.``-suffixed sibling on purpose —
+    ``RoutedClient.discover`` matches ``<prefix>`` or ``<prefix>/...``,
+    so the epoch entry never masquerades as a daemon endpoint."""
+    base, sep, tail = service.rpartition("/")
+    if sep and tail.isdigit():
+        service = base
+    return service + ".epoch"
+
+
+def bump_ring_epoch(pmux, service: str) -> int:
+    """Read-increment-publish the ring version (every membership
+    change — join, leave, drain, crash cleanup — bumps it; clients
+    poll the single entry instead of re-listing the registry). The
+    RMW is unlocked: concurrent bumps may collapse into one, which is
+    fine — clients only need the value to CHANGE, and a refresh reads
+    the full registry anyway."""
+    svc = epoch_service_for(service)
+    cur = int(pmux.get(svc) or 0)
+    # pmux rejects a value already published as another service's
+    # PORT (epoch rides the port slot of its entry) — skip over
+    # collisions; any strictly larger value is a valid bump
+    for nxt in range(cur + 1, cur + 17):
+        try:
+            pmux.use(svc, nxt)
+            return nxt
+        except OSError:
+            continue
+    raise OSError(f"could not bump {svc} past {cur}")
+
+
 class _Conn:
     __slots__ = ("sock", "addr", "rbuf")
 
@@ -64,7 +97,8 @@ class VerifierDaemon:
                  pmux_port: Optional[int] = None,
                  pmux_service: str = PMUX_SERVICE,
                  store_root: Optional[str] = None,
-                 artifact_interval_s: float = 30.0):
+                 artifact_interval_s: float = 30.0,
+                 drain_grace_s: float = 10.0):
         self.core = core
         if coalesce_s is not None:
             # legacy knob: the coalesce window is now the core's
@@ -75,7 +109,13 @@ class VerifierDaemon:
         self.pmux_service = pmux_service
         self.store_root = store_root
         self.artifact_interval_s = artifact_interval_s
+        #: after drain entry, how long to keep serving session
+        #: handoffs (checkpoint fetches) before closing anyway
+        self.drain_grace_s = float(drain_grace_s)
         self._stop = False
+        self._draining = False
+        self._drain_req = False
+        self._drain_deadline = 0.0
         self._published = False
         self._dropped_replies = 0
         self._sel = selectors.DefaultSelector()
@@ -100,6 +140,14 @@ class VerifierDaemon:
     def stop(self, *_args) -> None:
         self._stop = True
 
+    def drain(self, *_args) -> None:
+        """Request a graceful leave (SIGTERM lands here): deregister
+        from pmux and bump the ring epoch BEFORE anything closes,
+        re-route queued work, finalize staged dispatches, keep serving
+        session-checkpoint handoffs for ``drain_grace_s``, then exit.
+        Signal-safe — only sets a flag; the run loop does the work."""
+        self._drain_req = True
+
     def run(self) -> None:
         self._pmux_publish()
         last_artifact = obs.monotonic()
@@ -108,6 +156,9 @@ class VerifierDaemon:
                 timeout = self._select_timeout()
                 got_bytes = self._pump(timeout)
                 now = obs.monotonic()
+                if (self._drain_req or self.core.draining) \
+                        and not self._draining:
+                    self._begin_drain(now)
                 # the scheduler beat: launch due buckets; on a quiet
                 # round (no new bytes) launch everything forming and
                 # drain the in-flight ring — serial callers never
@@ -115,12 +166,45 @@ class VerifierDaemon:
                 for p, reply in self.core.pump(now,
                                                idle=not got_bytes):
                     self._send(p.ctx, reply)
+                if self._draining and self.core.drained() and \
+                        ((len(self.core.sessions) == 0
+                          and self.core.sessions.checkpoint_count()
+                          == 0)
+                         or now >= self._drain_deadline):
+                    # idle-EVICTED sessions hold the daemon through
+                    # the grace too: their host checkpoints are what
+                    # the handoff serves — exiting on resident==0
+                    # alone would discard them and cost the client a
+                    # full retained-delta replay
+                    self._stop = True
                 if self.store_root is not None and \
                         now - last_artifact >= self.artifact_interval_s:
                     self._save_artifact()
                     last_artifact = now
         finally:
             self._shutdown()
+
+    def _begin_drain(self, now: float) -> None:
+        """Drain entry ordering is the whole contract (the stale-
+        registration bug): DEREGISTER (+ epoch bump) first — so no
+        client routes new work here — then stop accepting connections,
+        then re-route the queued work. The listener closes while
+        existing connections stay open: clients must be able to fetch
+        their sessions' checkpoints through the grace window."""
+        self._draining = True
+        self._drain_deadline = now + self.drain_grace_s
+        self._pmux_withdraw()
+        try:
+            self._sel.unregister(self._lsock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.core.begin_drain(now)
+        logger.info("draining: grace %.1fs, %d session(s) resident",
+                    self.drain_grace_s, len(self.core.sessions))
 
     #: with work queued (forming batches, host/shrink work, staged
     #: dispatches), select() sleeps at most this long — the pump then
@@ -276,21 +360,40 @@ class VerifierDaemon:
         try:
             with PmuxClient(port=self.pmux_port) as c:
                 c.use(self.pmux_service, self.port)
-            self._published = True
-            logger.info("published %s -> %d via pmux:%d",
-                        self.pmux_service, self.port, self.pmux_port)
+                # the registration is LIVE from here: mark published
+                # BEFORE the epoch bump, or a bump failure would
+                # leave _published False and _pmux_withdraw would
+                # never delete the live entry — a permanently stale
+                # registration, the exact bug drain ordering fixes
+                self._published = True
+                # a join is a membership change: bump the ring
+                # version so RoutedClients refresh (~1/N of the
+                # shape classes remap onto this daemon)
+                self.core.ring_epoch = bump_ring_epoch(
+                    c, self.pmux_service)
+            logger.info("published %s -> %d via pmux:%d (epoch %d)",
+                        self.pmux_service, self.port, self.pmux_port,
+                        self.core.ring_epoch)
         except OSError as e:
             # discovery is additive; a dead pmux must not stop serving
-            logger.warning("pmux registration failed: %s", e)
+            logger.warning("pmux %s failed: %s",
+                           "epoch bump" if self._published
+                           else "registration", e)
 
     def _pmux_withdraw(self) -> None:
-        if self.pmux_port is None:
+        """Deregister + bump the ring epoch — the leave-side
+        membership change. Idempotent (drain runs it early; shutdown
+        runs it again)."""
+        if self.pmux_port is None or not self._published:
             return
+        self._published = False
         from ..control.pmux import PmuxClient
 
         try:
             with PmuxClient(port=self.pmux_port) as c:
                 c.delete(self.pmux_service)
+                self.core.ring_epoch = bump_ring_epoch(
+                    c, self.pmux_service)
         except OSError:
             pass
 
@@ -345,4 +448,5 @@ class VerifierDaemon:
             self._save_artifact()
 
 
-__all__ = ["PMUX_SERVICE", "VerifierDaemon"]
+__all__ = ["PMUX_SERVICE", "VerifierDaemon", "bump_ring_epoch",
+           "epoch_service_for"]

@@ -196,6 +196,24 @@ class XlaCarry:
         st, sl, va = self.carry[0], self.carry[1], self.carry[2]
         return int(st.size * 4 + sl.size * 4 + va.size)
 
+    def checkpoint(self) -> dict:
+        """HOST-numpy snapshot of the resident carry (np.asarray is a
+        readback, never a compile — the host-numpy-checkpoint rule).
+        Restore's re-upload rides the next delta dispatch's jit
+        transfer exactly like the initial ``_host_seg_carry``, so no
+        new program joins the inventory."""
+        return {"rung": "xla", "ns": self.ns, "nt": self.nt,
+                "P2": self.P2, "cap_ix": self.cap_ix,
+                "carry": tuple(np.asarray(x) for x in self.carry)}
+
+    @classmethod
+    def restore(cls, ck: dict) -> "XlaCarry":
+        eng = cls(int(ck["ns"]), int(ck["nt"]), int(ck["P2"]),
+                  cap_ix=int(ck["cap_ix"]))
+        eng.carry = tuple(np.asarray(x) for x in ck["carry"])
+        eng._pre = eng.carry
+        return eng
+
 
 class MxuCarry:
     """The MXU rung: packed-word carry, B=1 chunk form."""
@@ -247,6 +265,25 @@ class MxuCarry:
     def nbytes(self) -> int:
         words, valid = self.carry[0], self.carry[1]
         return int(sum(w.size * 4 for w in words) + valid.size)
+
+    def checkpoint(self) -> dict:
+        words, valid, n_b, status, fail = self.carry
+        return {"rung": "mxu", "ns": self.ns, "nt": self.nt,
+                "P2": self.P2, "cap_ix": self.cap_ix,
+                "carry": (tuple(np.asarray(w) for w in words),
+                          np.asarray(valid), np.asarray(n_b),
+                          np.asarray(status), np.asarray(fail))}
+
+    @classmethod
+    def restore(cls, ck: dict) -> "MxuCarry":
+        eng = cls(int(ck["ns"]), int(ck["nt"]), int(ck["P2"]),
+                  cap_ix=int(ck["cap_ix"]))
+        words, valid, n_b, status, fail = ck["carry"]
+        eng.carry = (tuple(np.asarray(w) for w in words),
+                     np.asarray(valid), np.asarray(n_b),
+                     np.asarray(status), np.asarray(fail))
+        eng._pre = eng.carry
+        return eng
 
 
 class KernelCarry:
@@ -300,6 +337,25 @@ class KernelCarry:
     def nbytes(self) -> int:
         return int(sum(w.size * 4 for w in self.ws)
                    + self.stat.size * 4)
+
+    def checkpoint(self) -> dict:
+        """The (ws, stat) word carry + result tile; K rides along so
+        restore can re-derive the identical spec (specs are pure
+        functions of (ns, nt, P2, K))."""
+        return {"rung": "kernel", "ns": self.ns, "nt": self.nt,
+                "K": int(self.spec.K),
+                "ws": tuple(np.asarray(w) for w in self.ws),
+                "stat": np.asarray(self.stat),
+                "res": np.asarray(self._res)}
+
+    @classmethod
+    def restore(cls, spec, ck: dict) -> "KernelCarry":
+        eng = cls(spec, int(ck["ns"]), int(ck["nt"]))
+        eng.ws = tuple(np.asarray(w) for w in ck["ws"])
+        eng.stat = np.asarray(ck["stat"])
+        eng._res = np.asarray(ck["res"])
+        eng._pre = (eng.ws, eng.stat)
+        return eng
 
 
 @functools.lru_cache(maxsize=16)

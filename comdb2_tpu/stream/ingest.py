@@ -322,6 +322,60 @@ class StreamIngest:
         self.settled = hi
         return lo, hi
 
+    # -- checkpoint / restore (docs/streaming.md "Checkpoint") ---------
+
+    #: the _Grow columns a checkpoint snapshots, in restore order
+    _COLS = ("type", "proc", "f", "fails", "time", "pair", "value",
+             "trans")
+
+    def checkpoint(self) -> dict:
+        """Host snapshot of the ingest: id tables, columns, watermark
+        and open-call state. The id-lookup dicts are NOT stored — they
+        are pure functions of the tables and rebuild on restore."""
+        return {
+            "process_table": list(self.process_table),
+            "f_table": list(self.f_table),
+            "value_table": list(self.value_table),
+            "transition_table": [tuple(t)
+                                 for t in self.transition_table],
+            "cols": {c: getattr(self, c).a.copy() for c in self._COLS},
+            "raw_values": list(self.raw_values),
+            "settled": int(self.settled),
+            "n_invokes_settled": int(self.n_invokes_settled),
+            "open_row": {int(k): int(v)
+                         for k, v in self._open_row.items()},
+            "unresolved": {int(k): int(v)
+                           for k, v in self._unresolved.items()},
+            "finalized": bool(self.finalized),
+        }
+
+    @classmethod
+    def restore(cls, ck: dict) -> "StreamIngest":
+        ing = cls()
+        ing.process_table = list(ck["process_table"])
+        ing._proc_ids = {x: i for i, x in
+                         enumerate(ing.process_table)}
+        ing.f_table = list(ck["f_table"])
+        ing._f_ids = {x: i for i, x in enumerate(ing.f_table)}
+        ing.value_table = list(ck["value_table"])
+        ing._val_ids = {x: i for i, x in enumerate(ing.value_table)}
+        ing.transition_table = [tuple(t)
+                                for t in ck["transition_table"]]
+        ing._tr_ids = {t: i for i, t in
+                       enumerate(ing.transition_table)}
+        for c in cls._COLS:
+            col = getattr(ing, c)
+            col.extend(np.asarray(ck["cols"][c], col._buf.dtype))
+        ing.raw_values = list(ck["raw_values"])
+        ing.settled = int(ck["settled"])
+        ing.n_invokes_settled = int(ck["n_invokes_settled"])
+        ing._open_row = {int(k): int(v)
+                         for k, v in ck["open_row"].items()}
+        ing._unresolved = {int(k): int(v)
+                           for k, v in ck["unresolved"].items()}
+        ing.finalized = bool(ck["finalized"])
+        return ing
+
     # -- API edges -----------------------------------------------------
 
     def settled_slice(self, lo: int, hi: int):

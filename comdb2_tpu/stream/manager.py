@@ -1,21 +1,31 @@
-"""Session table — ids, caps, TTL/idle eviction, carry accounting.
+"""Session table — ids, caps, checkpoint eviction, carry accounting.
 
 The service owns one :class:`SessionManager`; every verb resolves the
-session id through it. Two production guards live here:
+session id through it. Production guards:
 
 - ``max_sessions``: a carry is real device memory — the cap answers
   ``open`` with overload (+ ``retry_after_ms``) instead of silently
   OOMing the accelerator under a session flood.
-- idle eviction: a session nobody appended to for ``idle_s`` releases
-  its carry (the devices' analog of a KV-cache eviction); the client
-  re-opens by replaying its retained deltas (session affinity +
-  failover replay, docs/streaming.md).
+- idle eviction is **checkpoint-not-replay** (round 12): a session
+  nobody appended to for ``idle_s`` snapshots to a host-numpy
+  checkpoint (:meth:`~.session.StreamSession.checkpoint`) and
+  releases its device carry; the next verb naming the id restores it
+  transparently — the devices' analog of paging a KV-cache out to
+  host, no client replay, no re-dispatch. Checkpoints are bounded
+  (``max_checkpoints``, FIFO) — one aged fully out still falls back
+  to the client's retained-delta replay (docs/streaming.md
+  "Failover").
+- migration: :meth:`checkpoint` (with ``release=True``) hands a
+  session's snapshot out for a drain/leave handoff and
+  :meth:`open_restored` accepts one on the new ring owner —
+  O(carry) over the wire, zero device replay.
 """
 
 from __future__ import annotations
 
 import itertools
 import os
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from ..obs import trace as _obs
@@ -32,13 +42,18 @@ class SessionManager:
     floats passed in by the caller (the daemon owns the clock)."""
 
     def __init__(self, max_sessions: int = 64,
-                 idle_s: float = 300.0):
+                 idle_s: float = 300.0,
+                 max_checkpoints: int = 256):
         self.max_sessions = int(max_sessions)
         self.idle_s = float(idle_s)
+        self.max_checkpoints = int(max_checkpoints)
         self._sessions: Dict[str, StreamSession] = {}
         self._touched: Dict[str, float] = {}
+        #: evicted sessions' host checkpoints, FIFO-bounded
+        self._checkpoints: "OrderedDict[str, dict]" = OrderedDict()
         self._seq = itertools.count()
         self.evictions = 0
+        self.restores = 0
         self.opened = 0
 
     def __len__(self) -> int:
@@ -50,7 +65,7 @@ class SessionManager:
         if len(self._sessions) >= self.max_sessions:
             raise SessionLimit(
                 f"session table at cap ({self.max_sessions})")
-        sid = f"s{next(self._seq)}-{os.urandom(3).hex()}"
+        sid = self._new_sid()
         s = StreamSession(model=model, engine=engine,
                           max_states=max_states)
         self._sessions[sid] = s
@@ -58,38 +73,120 @@ class SessionManager:
         self.opened += 1
         return sid, s
 
+    def open_restored(self, now: float,
+                      ck: dict) -> Tuple[str, StreamSession]:
+        """Admit a migrated session from its checkpoint (the
+        open-with-checkpoint handoff). Same cap as :meth:`open` — a
+        shed migration surfaces as overload and the client falls back
+        to retained-delta replay elsewhere."""
+        if len(self._sessions) >= self.max_sessions:
+            raise SessionLimit(
+                f"session table at cap ({self.max_sessions})")
+        s = StreamSession.restore(ck)
+        sid = self._new_sid()
+        self._sessions[sid] = s
+        self._touched[sid] = now
+        self.opened += 1
+        return sid, s
+
+    def _new_sid(self) -> str:
+        return f"s{next(self._seq)}-{os.urandom(3).hex()}"
+
     def get(self, sid, now: Optional[float] = None
             ) -> Optional[StreamSession]:
         s = self._sessions.get(sid)
+        if s is None and sid in self._checkpoints:
+            # checkpoint eviction's other half: restore transparently.
+            # Deliberately allowed to run the table transiently past
+            # max_sessions — the cap gates NEW carries (opens); a
+            # restore re-admits state a client already owns, and
+            # bouncing it would only trade a cheap upload for a full
+            # client replay.
+            ck = self._checkpoints.pop(sid)
+            s = StreamSession.restore(ck)
+            self._sessions[sid] = s
+            self.restores += 1
+            if now is not None:
+                _obs.record("stream.restore", now, now, sid=sid)
         if s is not None and now is not None:
             self._touched[sid] = now
         return s
 
     def close(self, sid) -> Optional[dict]:
-        s = self._sessions.pop(sid, None)
+        # a checkpointed session still closes cleanly: restore (via
+        # get) settles nothing by itself; close() then runs the final
+        # tail settle against the restored carry
+        s = self.get(sid)
+        self._sessions.pop(sid, None)
         self._touched.pop(sid, None)
         if s is None:
             return None
         return s.close()
 
+    def checkpoint(self, sid) -> Optional[dict]:
+        """Snapshot one session (the migration handoff's read half).
+        The caller :meth:`drop`s it AFTER the snapshot is safely
+        encoded/delivered — a handoff MOVES the session (both daemons
+        serving it would double-serve its appends), but releasing
+        before the checkpoint provably left this process would LOSE
+        it on an encode failure."""
+        ck = self._checkpoints.get(sid)
+        if ck is not None:
+            # idle-evicted: the held host snapshot IS the requested
+            # artifact. Restoring just to re-snapshot would replay
+            # the memo extend log (and, kernel rung, a device
+            # re-route) on the single-threaded drain path — and
+            # migration-during-drain is exactly when sessions sit
+            # evicted. The caller's drop() discards this entry on
+            # release like any resident session.
+            return ck
+        s = self.get(sid)
+        if s is None:
+            return None
+        return s.checkpoint()
+
+    def drop(self, sid) -> None:
+        """Remove a session and free its carry WITHOUT the final tail
+        settle (the handoff's release half; also discards any held
+        checkpoint under the same id)."""
+        s = self._sessions.pop(sid, None)
+        self._touched.pop(sid, None)
+        self._checkpoints.pop(sid, None)
+        if s is not None:
+            s.release()
+
     def evict_idle(self, now: float) -> List[str]:
-        """Release every session idle past the TTL (carry freed; the
-        session object dies — re-open replays client-side)."""
+        """Checkpoint-and-release every session idle past the TTL
+        (device carry freed; the host checkpoint keeps the session
+        resumable with zero replay)."""
         out = []
         for sid, t in list(self._touched.items()):
             if now - t >= self.idle_s:
                 s = self._sessions.pop(sid, None)
                 self._touched.pop(sid, None)
                 if s is not None:
-                    s.release()         # forces any in-flight staged
-                    out.append(sid)     # append through finalize
+                    # the snapshot itself forces any in-flight staged
+                    # append through its (idempotent) finalize — a
+                    # ring-resident dispatch never reads a released
+                    # engine
+                    self._checkpoints[sid] = s.checkpoint()
+                    while len(self._checkpoints) > self.max_checkpoints:
+                        self._checkpoints.popitem(last=False)
+                    s.release()
+                    out.append(sid)
                     self.evictions += 1
                     _obs.record("stream.evict", now, now, sid=sid)
         return out
 
     def carry_bytes(self) -> int:
+        """DEVICE bytes held by resident carries (checkpointed
+        sessions hold host memory only — see
+        :meth:`checkpoint_count`)."""
         return sum(s.carry_nbytes()
                    for s in self._sessions.values())
+
+    def checkpoint_count(self) -> int:
+        return len(self._checkpoints)
 
 
 __all__ = ["SessionLimit", "SessionManager"]

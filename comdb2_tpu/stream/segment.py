@@ -223,6 +223,47 @@ class StreamSegmenter:
                 om[s, :len(row)] = row
         self.owner_map.extend(om)
 
+    # -- checkpoint / restore (docs/streaming.md "Checkpoint") ---------
+
+    def checkpoint(self) -> dict:
+        """Host snapshot: the carried renamer/tail state plus the
+        retained renamed segment stream (the session's replay/decode
+        source — without it a restored session could never re-route)."""
+        return {
+            "pending": int(self.pending),
+            "tail_proc": list(self._tail_proc),
+            "tail_tr": list(self._tail_tr),
+            "slot_of": {int(k): int(v)
+                        for k, v in self._slot_of.items()},
+            "free": [int(x) for x in self._free],
+            "owners": [int(x) for x in self._owners],
+            "p_eff": int(self.p_eff),
+            "inv_slot": self.inv_slot.a.copy(),
+            "inv_tr": self.inv_tr.a.copy(),
+            "ok_slot": self.ok_slot.a.copy(),
+            "depth": self.depth.a.copy(),
+            "seg_row": self.seg_row.a.copy(),
+            "owner_map": self.owner_map.a.copy(),
+        }
+
+    @classmethod
+    def restore(cls, ck: dict) -> "StreamSegmenter":
+        seg = cls()
+        seg.pending = int(ck["pending"])
+        seg._tail_proc = [int(x) for x in ck["tail_proc"]]
+        seg._tail_tr = [int(x) for x in ck["tail_tr"]]
+        seg._slot_of = {int(k): int(v)
+                        for k, v in ck["slot_of"].items()}
+        # a copied heap list keeps the heap invariant — no re-heapify
+        seg._free = [int(x) for x in ck["free"]]
+        seg._owners = [int(x) for x in ck["owners"]]
+        seg.p_eff = int(ck["p_eff"])
+        for name in ("inv_slot", "inv_tr", "ok_slot", "depth",
+                     "seg_row", "owner_map"):
+            buf = getattr(seg, name)
+            buf.extend(np.asarray(ck[name]).astype(buf.a.dtype))
+        return seg
+
     # -- dispatch views ------------------------------------------------
 
     def padded(self, s_lo: int, s_hi: int, s_pad: int, k_pad: int):

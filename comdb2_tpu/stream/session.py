@@ -163,6 +163,101 @@ class StreamSession:
         return (f"stream-{self._rung or 'new'}-p{self.P2}"
                 f"-k{self._k_bucket()}-t{ns}x{nt}")
 
+    # -- checkpoint / restore (docs/streaming.md "Checkpoint") ---------
+
+    def checkpoint(self) -> dict:
+        """Host-numpy snapshot of the whole session: the engine carry
+        (the device-resident piece — O(carry)), the ingest watermark +
+        columns, the segment tail + renamer + retained renamed stream,
+        and the memo's extend log. Restoring from it resumes with the
+        SAME state ids, segment coordinates and carry bits as the live
+        session (golden-tested), so eviction and migration cost zero
+        device replay — per-append dispatches stay O(delta) after a
+        handoff. Forces any staged append through its finalize first
+        (a snapshot must never be mid-dispatch)."""
+        if self._inflight is not None:
+            self._inflight()
+        return {
+            "v": 1,
+            "model": self.model_name,
+            "engine_policy": self.engine_policy,
+            "keyed": bool(getattr(self, "keyed", False)),
+            "P2": int(self.P2),
+            "rung": self._rung,
+            "dispatched_segments": int(self.dispatched_segments),
+            "appends": int(self.appends),
+            "dispatches": int(self.dispatches),
+            "replays": int(self.replays),
+            "valid": self.valid,
+            "cause": self.cause,
+            "fail_index": int(self.fail_index),
+            "final_count": int(self.final_count),
+            "engines_tried": list(self.engines_tried),
+            "closed": bool(self.closed),
+            "memo": self.memo.checkpoint(),
+            "ingest": self.ingest.checkpoint(),
+            "seg": self.seg.checkpoint(),
+            "eng": (self._eng.checkpoint()
+                    if self._eng is not None else None),
+        }
+
+    @classmethod
+    def restore(cls, ck: dict) -> "StreamSession":
+        """Rebuild a session from :meth:`checkpoint`. The memo replays
+        its extend log (state ids bit-identical — the carry stores
+        them), the engine carry re-uploads on the next delta dispatch
+        (no extra program, no replay), and a kernel-rung checkpoint
+        restored where the fused kernel is unavailable re-routes onto
+        a host-serviceable rung by replaying the retained segments —
+        the same O(history) event a live crossing pays."""
+        if ck.get("v") != 1:
+            raise ValueError(f"unknown checkpoint version {ck.get('v')!r}")
+        model = ck["model"]
+        if model not in MODELS:
+            raise ValueError(f"unknown model {model!r} in checkpoint")
+        s = cls(model, engine=ck["engine_policy"],
+                max_states=int(ck["memo"]["max_states"]))
+        s.keyed = bool(ck["keyed"])
+        s.memo = IncrementalMemo.restore(MODELS[model](), ck["memo"])
+        from .ingest import StreamIngest as _SI
+        from .segment import StreamSegmenter as _SS
+
+        s.ingest = _SI.restore(ck["ingest"])
+        s.seg = _SS.restore(ck["seg"])
+        s.P2 = int(ck["P2"])
+        s._rung = ck["rung"]
+        s.dispatched_segments = int(ck["dispatched_segments"])
+        s.appends = int(ck["appends"])
+        s.dispatches = int(ck["dispatches"])
+        s.replays = int(ck["replays"])
+        s.valid = ck["valid"]
+        s.cause = ck["cause"]
+        s.fail_index = int(ck["fail_index"])
+        s.final_count = int(ck["final_count"])
+        s.engines_tried = list(ck["engines_tried"])
+        s.closed = bool(ck["closed"])
+        eng_ck = ck["eng"]
+        if eng_ck is None:
+            return s
+        rung = eng_ck["rung"]
+        if rung == "xla":
+            s._eng = ENG.XlaCarry.restore(eng_ck)
+        elif rung == "mxu":
+            s._eng = ENG.MxuCarry.restore(eng_ck)
+        else:
+            spec = ENG.kernel_spec(int(eng_ck["ns"]),
+                                   int(eng_ck["nt"]), s.P2,
+                                   int(eng_ck["K"]))
+            if spec is None:
+                # fused kernel unavailable here (e.g. restored onto a
+                # CPU daemon without interpret mode): replay the
+                # retained segments onto a serviceable rung
+                s._eng = None
+                s._reroute(note="kernel unavailable at restore")
+                return s
+            s._eng = ENG.KernelCarry.restore(spec, eng_ck)
+        return s
+
     def counterexample(self, F: int = 4096):
         """Bounded failing-config reconstruction on the retained
         columnar tables (the owner-map decode path — API edge)."""

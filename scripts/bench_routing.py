@@ -254,14 +254,18 @@ def main() -> int:
             f"program space did not partition: {programs}")
 
         # failover: stop daemon 1, a request keyed to it must answer
-        # from daemon 0 via the ring walk
+        # from daemon 0 — either via the ring walk (a connect error
+        # blacklists the corpse) or, since round 12, via the epoch
+        # bump the withdrawing daemon published (the client refreshes
+        # its ring BEFORE ever dialing the dead node)
         victim = next(t for t, name in zip(texts, plan)
                       if name == "sut/verifier/1")
         stop_daemon(d1, port1)
         procs.remove((d1, port1))
         r = rc.check(victim)
         assert r.get("ok"), f"failover failed: {r}"
-        assert rc.failovers >= 1
+        assert rc.failovers >= 1 or rc.refreshes >= 1, \
+            (rc.failovers, rc.refreshes)
     finally:
         for proc, port in procs:
             stop_daemon(proc, port)

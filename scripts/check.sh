@@ -448,6 +448,31 @@ if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
     exit 1
 fi
 
+stage elastic "elastic fleet smoke (supervisor, SIGKILL nemesis, join, migration)"
+# the round-12 gate, quick form: the supervisor boots a 2-daemon
+# pmux-registered fleet, one daemon is SIGKILLed mid-traffic (the
+# survivor must serve its remapped classes; the supervisor reaps the
+# corpse, deletes its stale registration, bumps the ring epoch and
+# respawns to the floor), a third daemon joins under burst (~1/N
+# shape-class remap, gated), a streaming session migrates off a
+# draining daemon by checkpoint (O(delta) afterward — no replay),
+# and the client-observed fleet history is checked VALID by the
+# fleet itself. Zombie accounting shell-side too: the supervisor
+# must reap every child (no init reaper in this container).
+ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+run env JAX_PLATFORMS=cpu python scripts/bench_elastic.py --quick \
+    --out /tmp/bench_elastic_smoke.json
+if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
+    echo "elastic smoke left a daemon behind" >&2
+    exit 1
+fi
+ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
+if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+    echo "elastic smoke left a zombie" \
+         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
+    exit 1
+fi
+
 stage obs "tracing + metrics plane smoke (daemon --trace --store)"
 # boot with tracing on, run one check + one shrink, scrape the
 # metrics (kind:"metrics"), then assert the shutdown trace artifact
@@ -522,6 +547,8 @@ if [ "$JSON_MODE" = 0 ]; then
          "mxu smoke answered both wide-P fixtures," \
          "multichip dryrun bit-identical across the mesh," \
          "verifier service shutdown clean, two-daemon pmux routing" \
-         "served on both shards, obs smoke traced a check+shrink" \
+         "served on both shards, elastic smoke survived the SIGKILL" \
+         "nemesis + join + checkpoint migration with the fleet" \
+         "history VALID, obs smoke traced a check+shrink" \
          "with populated histograms"
 fi

@@ -1,0 +1,37 @@
+"""Seeded violation: a session checkpoint built with DEVICE ops —
+the ``host-numpy-checkpoint`` rule. A checkpoint/restore builder is
+an eviction/migration artifact: composing it from jnp ops compiles
+infra programs (pad/scatter per carry shape) OUTSIDE the declared
+``stream-delta`` inventory — one per session shape, re-paid on every
+eviction beat — and eagerly round-trips the ~100 ms tunnel, where
+``np.asarray`` is a plain readback and the restore upload rides the
+next delta dispatch's existing jit transfer."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def checkpoint_carry(carry):
+    # BUG: jnp.pad/jnp.stack trace + compile a program per carry
+    # shape; the snapshot must be np.asarray readbacks only
+    states, slots, valid = carry
+    wide = jnp.pad(states, (0, 16))
+    return {"states": wide, "slots": jnp.stack([slots, slots]),
+            "valid": np.asarray(valid)}
+
+
+def restore_carry(ck):
+    # BUG: eager device_put per restore — the next delta dispatch's
+    # jit transfer already uploads host numpy for free
+    import jax
+
+    return tuple(jax.device_put(np.asarray(x)) for x in ck.values())
+
+
+def checkpoint_stat(stat):
+    # BUG: `import jax.numpy` with NO asname binds the name `jax` —
+    # the full jax.numpy.* chain is the same device op and must trip
+    # the rule like the aliased form
+    import jax.numpy
+
+    return {"stat": jax.numpy.zeros_like(stat)}

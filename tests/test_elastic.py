@@ -1,0 +1,600 @@
+"""Elastic fleet (round 12, docs/service.md "Elastic fleet"):
+ring-epoch membership, session checkpoint/restore/migration, drain,
+supervisor lifecycle, and the routed client's failure policy.
+
+The load-bearing contracts:
+
+- a membership change remaps ≈1/N of the ring's keys, never a
+  reshuffle;
+- a checkpoint restores BIT-identical to the live carry on every
+  engine rung, and a migrated-mid-session twin reaches the identical
+  verdict with zero replay (per-append dispatches stay O(delta));
+- a draining core re-routes its forming batches, finalizes staged
+  dispatches with real replies, and keeps serving checkpoint
+  handoffs;
+- the supervisor reaps every child it retires (this container has no
+  init reaper — an unreaped daemon is a zombie, CLAUDE.md).
+"""
+
+import os
+import random
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.obs import trace as obs
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.history import history_to_edn
+from comdb2_tpu.ops.synth import (inject_anomaly, pinned_wide_history,
+                                  register_history)
+from comdb2_tpu.service.client import (HashRing, RoutedClient,
+                                       RoutedStream, ServiceError)
+from comdb2_tpu.service.core import VerifierCore
+from comdb2_tpu.service.daemon import (bump_ring_epoch,
+                                       epoch_service_for)
+from comdb2_tpu.stream import checkpoint as CK
+from comdb2_tpu.stream.session import StreamSession
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _feed(s, h, lo, hi, step=9):
+    i = lo
+    while i < hi:
+        s.append(h[i:min(i + step, hi)])
+        i += step
+
+
+def _oneshot(h, model, F=1024):
+    from comdb2_tpu.checker.batch import check_batch, pack_batch
+    from comdb2_tpu.models.model import MODELS
+    from comdb2_tpu.ops.packed import pack_history
+
+    b = pack_batch([pack_history(list(h))], MODELS[model]())
+    st, fa, nf = check_batch(b, F=F)
+    return int(st[0]), int(fa[0]), int(nf[0])
+
+
+# --- ring epochs -------------------------------------------------------------
+
+def test_hash_ring_join_remaps_about_one_over_n():
+    """Adding one node to an N-node ring remaps ~1/(N+1) of the keys
+    — consistent hashing's whole point; a modulo ring would remap
+    ~all of them. Bounded generously (md5 + 64 vnodes jitters)."""
+    nodes = [f"sut/verifier/{i}" for i in range(4)]
+    before = HashRing(nodes)
+    after = HashRing(nodes + ["sut/verifier/4"])
+    keys = [f"check|cas-register|{1 << (i % 12)}|{i}"
+            for i in range(512)]
+    moved = sum(before.nodes_for(k)[0] != after.nodes_for(k)[0]
+                for k in keys)
+    frac = moved / len(keys)
+    assert 0.02 < frac < 0.45, frac          # ~0.2 expected
+    # and every moved key landed on the NEW node (join never shuffles
+    # keys between survivors)
+    for k in keys:
+        a, b = before.nodes_for(k)[0], after.nodes_for(k)[0]
+        if a != b:
+            assert b == "sut/verifier/4", (k, a, b)
+
+
+def test_epoch_service_name_is_not_a_daemon_endpoint():
+    """The epoch entry must never be discovered as a fleet member:
+    RoutedClient matches ``<prefix>`` or ``<prefix>/...``; the epoch
+    rides a ``.``-suffixed sibling."""
+    prefix = "sut/verifier"
+    for svc in (prefix, f"{prefix}/0", f"{prefix}/17"):
+        assert epoch_service_for(svc) == "sut/verifier.epoch"
+    e = epoch_service_for(prefix)
+    assert e != prefix and not e.startswith(prefix + "/")
+
+
+# --- checkpoint/restore bit parity per rung ----------------------------------
+
+def _ck_roundtrip(s):
+    """checkpoint -> wire -> restore; returns (in-process ck,
+    restored session)."""
+    ck = s.checkpoint()
+    wire = CK.to_wire(ck)
+    assert CK.wire_nbytes(wire) > 0
+    return ck, StreamSession.restore(CK.from_wire(wire))
+
+
+def test_checkpoint_restore_bit_parity_xla():
+    h = register_history(random.Random(4), n_procs=3, n_events=120,
+                         values=2, p_info=0.0, max_pending=2)
+    s = StreamSession("cas-register", engine="xla")
+    _feed(s, h, 0, len(h) // 2)
+    ck, r = _ck_roundtrip(s)
+    assert r._rung == "xla"
+    for i, (a, b) in enumerate(zip(ck["eng"]["carry"],
+                                   r._eng.carry)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"carry[{i}]")
+    # the memo replay reproduces ids exactly (the carry stores them)
+    assert r.memo.n_states == s.memo.n_states
+    np.testing.assert_array_equal(r.memo.succ, s.memo.succ)
+    # segment stream + renamer state identical
+    assert r.seg.n_segments == s.seg.n_segments
+    assert r.seg.p_eff == s.seg.p_eff
+    np.testing.assert_array_equal(r.seg.inv_slot.a, s.seg.inv_slot.a)
+    np.testing.assert_array_equal(r.seg.ok_slot.a, s.seg.ok_slot.a)
+
+
+def test_checkpoint_restore_bit_parity_mxu():
+    h = pinned_wide_history(18)
+    s = StreamSession("cas-register")
+    _feed(s, h, 0, len(h), step=23)
+    assert s._rung == "mxu"
+    ck, r = _ck_roundtrip(s)
+    assert r._rung == "mxu"
+    cw, rw = ck["eng"]["carry"], r._eng.carry
+    for i, (a, b) in enumerate(zip(cw[0], rw[0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"words[{i}]")
+    for i in range(1, 5):
+        np.testing.assert_array_equal(np.asarray(cw[i]),
+                                      np.asarray(rw[i]))
+    out = r.finalize_input()
+    exp = _oneshot(h, "cas-register")
+    assert (out["valid"] is True) == (exp[0] == 0)
+
+
+@pytest.fixture()
+def interpret_kernel():
+    from comdb2_tpu.checker import pallas_seg as PS
+
+    PS.use_interpret(True)
+    PS.available.cache_clear()
+    yield
+    PS.use_interpret(False)
+    PS.available.cache_clear()
+
+
+def test_checkpoint_restore_bit_parity_kernel(interpret_kernel):
+    """The kernel rung's (ws, stat) word carry round-trips exactly
+    (interpret mode: the exact kernel as XLA ops on CPU)."""
+    h1 = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+          O.invoke(1, "write", 2), O.ok(1, "write", 2),
+          O.invoke(0, "read", None), O.ok(0, "read", 2)]
+    h3 = [O.invoke(0, "read", None), O.ok(0, "read", 1)]  # stale
+    s = StreamSession("cas-register")
+    s.append(h1)
+    assert s._rung == "kernel"
+    ck, r = _ck_roundtrip(s)
+    assert r._rung == "kernel"
+    for i, (a, b) in enumerate(zip(ck["eng"]["ws"], r._eng.ws)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"ws[{i}]")
+    np.testing.assert_array_equal(np.asarray(ck["eng"]["stat"]),
+                                  np.asarray(r._eng.stat))
+    # the restored session catches the violation the live one would
+    out = r.append(h3)
+    assert out["valid"] is False
+    assert r.replays == 0
+
+
+def test_kernel_checkpoint_restores_without_kernel_support():
+    """A kernel-rung checkpoint restored where the fused kernel can't
+    run (plain CPU) re-routes by replaying the retained segments —
+    the same O(history) event a live crossing pays — instead of
+    failing the restore."""
+    from comdb2_tpu.checker import pallas_seg as PS
+
+    PS.use_interpret(True)
+    PS.available.cache_clear()
+    try:
+        s = StreamSession("cas-register")
+        s.append([O.invoke(0, "write", 1), O.ok(0, "write", 1),
+                  O.invoke(1, "read", None), O.ok(1, "read", 1)])
+        assert s._rung == "kernel"
+        ck = CK.to_wire(s.checkpoint())
+    finally:
+        PS.use_interpret(False)
+        PS.available.cache_clear()
+    r = StreamSession.restore(CK.from_wire(ck))
+    assert r._rung in ("xla", "mxu")
+    assert r.replays == 1
+    out = r.append([O.invoke(0, "read", None), O.ok(0, "read", 9)])
+    assert out["valid"] is False
+
+
+# --- migration parity + O(delta) ---------------------------------------------
+
+@pytest.mark.parametrize("name,h", [
+    ("valid", register_history(random.Random(41), n_procs=3,
+                               n_events=96, values=2, p_info=0.0,
+                               max_pending=2)),
+    ("invalid-tail", inject_anomaly(
+        register_history(random.Random(42), n_procs=3, n_events=60),
+        "stale-read")[0]),
+])
+def test_migration_mid_session_verdict_parity(name, h):
+    h = list(h)
+    twin = StreamSession("cas-register", engine="xla")
+    _feed(twin, h, 0, len(h))
+    exp = twin.finalize_input()
+    cut = len(h) // 2
+    s = StreamSession("cas-register", engine="xla")
+    _feed(s, h, 0, cut)
+    d_half = s.dispatches
+    _ck, r = _ck_roundtrip(s)
+    _feed(r, h, cut, len(h))
+    out = r.finalize_input()
+    assert out["valid"] == exp["valid"], (name, exp, out)
+    assert out["op_index"] == exp["op_index"]
+    if exp["valid"] is True:
+        assert out["final_count"] == exp["final_count"]
+    # O(delta) after handoff: the second half costs about what the
+    # first half did — never a full-history replay
+    assert out["replays"] == 0
+    assert out["dispatches"] - d_half <= d_half + 2, out
+
+
+# --- eviction-restore round trip through the service -------------------------
+
+def test_core_eviction_restore_round_trip():
+    h = register_history(random.Random(8), n_procs=3, n_events=48,
+                         p_info=0.0, max_pending=2)
+    cut = len(h) // 2
+    core = VerifierCore(batch_cap=8, session_idle_s=5.0)
+    now = obs.monotonic()
+    _, r = core.submit({"kind": "stream", "verb": "open", "id": 1},
+                       now)
+    sid = r["session"]
+    core.submit({"kind": "stream", "verb": "append", "id": 2,
+                 "session": sid, "history": history_to_edn(h[:cut])},
+                now)
+    (_, rep), = core.tick(now)
+    assert rep["valid"] is True
+    core.pump(now + 6.0)                 # idle TTL passes -> evict
+    assert core.m["stream_evicted"] == 1
+    assert len(core.sessions) == 0
+    assert core.sessions.checkpoint_count() == 1
+    # the next append restores transparently — no unknown-session,
+    # no client replay
+    core.submit({"kind": "stream", "verb": "append", "id": 3,
+                 "session": sid, "history": history_to_edn(h[cut:])},
+                now + 7.0)
+    (_, rep2), = core.tick(now + 7.0)
+    assert rep2["valid"] is True, rep2
+    assert rep2["replays"] == 0
+    assert core.sessions.restores == 1
+    _, cl = core.submit({"kind": "stream", "verb": "close", "id": 4,
+                         "session": sid}, now + 8.0)
+    assert cl["valid"] is True
+    exp = _oneshot(h, "cas-register")
+    assert (exp[0] == 0) and cl["final_count"] == exp[2]
+
+
+def test_checkpoint_of_evicted_session_serves_held_snapshot():
+    """``verb:"checkpoint"`` on an idle-evicted session must serve
+    the HELD host snapshot — restoring just to re-snapshot would
+    replay the memo extend log (and a kernel rung a device re-route)
+    on the single-threaded drain path, and migration-during-drain is
+    exactly when sessions sit evicted. ``release:true`` still drops
+    the held entry (the MOVE's destructive half)."""
+    h = register_history(random.Random(9), n_procs=3, n_events=30,
+                         p_info=0.0, max_pending=2)
+    core = VerifierCore(batch_cap=8, session_idle_s=5.0)
+    now = obs.monotonic()
+    _, r = core.submit({"kind": "stream", "verb": "open", "id": 1},
+                       now)
+    sid = r["session"]
+    core.submit({"kind": "stream", "verb": "append", "id": 2,
+                 "session": sid, "history": history_to_edn(h)}, now)
+    core.tick(now)
+    core.pump(now + 6.0)                 # idle TTL passes -> evict
+    assert core.sessions.checkpoint_count() == 1
+    _, ckr = core.submit({"kind": "stream", "verb": "checkpoint",
+                          "id": 3, "session": sid, "release": True},
+                         now + 7.0)
+    assert ckr["ok"] and ckr["released"], ckr
+    assert core.sessions.restores == 0   # served, never restored
+    assert core.sessions.checkpoint_count() == 0   # MOVE completed
+    # the handed-off checkpoint restores identically elsewhere
+    core2 = VerifierCore(batch_cap=8)
+    _, mo = core2.submit({"kind": "stream", "verb": "open", "id": 4,
+                          "checkpoint": ckr["checkpoint"]},
+                         now + 8.0)
+    assert mo["ok"] and mo["migrated"], mo
+    _, cl = core2.submit({"kind": "stream", "verb": "close", "id": 5,
+                          "session": mo["session"]}, now + 9.0)
+    assert cl["valid"] is True
+    exp = _oneshot(h, "cas-register")
+    assert (exp[0] == 0) and cl["final_count"] == exp[2]
+
+
+# --- drain -------------------------------------------------------------------
+
+def test_drain_finalizes_staged_and_reroutes_forming():
+    """Under drain: requests already STAGED in the in-flight ring
+    finalize with real verdicts; requests still FORMING answer
+    shutting-down (the client re-routes); new work sheds; the
+    checkpoint handoff verbs keep working."""
+    core = VerifierCore(batch_cap=2, F=64)
+    now = obs.monotonic()
+
+    def sub(i, n_events, seed):
+        h = register_history(random.Random(seed), 3, n_events,
+                             p_info=0.0)
+        return core.submit({"op": "check", "id": i,
+                            "history": history_to_edn(h)}, now)
+
+    # two same-bucket requests (identical shape: same seed) hit the
+    # cap -> staged into the ring inside submit (launch_full); a
+    # third (different size class) stays forming
+    p1, r1 = sub(1, 24, 0)
+    p2, r2 = sub(2, 24, 0)
+    assert r1 is None and r2 is None
+    assert core.inflight() == 1, "batch did not stage"
+    p3, r3 = sub(3, 180, 2)
+    assert r3 is None and core.queue_depth() == 1
+    _, dr = core.submit({"kind": "drain", "id": 99}, now)
+    assert dr["ok"] and dr["draining"] and dr["flushed"] == 1
+    replies = {rep.get("id"): rep for _, rep in core.pump(now)}
+    # the staged pair finalized with real verdicts...
+    assert replies[1]["ok"] and replies[1]["valid"] is True
+    assert replies[2]["ok"] and replies[2]["valid"] is True
+    # ...the forming one re-routed
+    assert replies[3]["ok"] is False
+    assert replies[3]["error"] == "shutting-down"
+    assert core.drained()
+    # new work sheds; the metrics scrape still answers
+    _, shed = sub(4, 24, 3)
+    assert shed["error"] == "shutting-down" and shed["draining"]
+    _, m = core.submit({"kind": "metrics", "id": 5}, now)
+    assert m is None or m["ok"]
+
+
+def test_drain_serves_checkpoint_handoff():
+    h = register_history(random.Random(9), n_procs=3, n_events=40,
+                         p_info=0.0, max_pending=2)
+    core = VerifierCore(batch_cap=8)
+    now = obs.monotonic()
+    _, r = core.submit({"kind": "stream", "verb": "open", "id": 1},
+                       now)
+    sid = r["session"]
+    core.submit({"kind": "stream", "verb": "append", "id": 2,
+                 "session": sid, "history": history_to_edn(h)}, now)
+    core.tick(now)
+    core.submit({"kind": "drain", "id": 3}, now)
+    # append sheds, checkpoint (the handoff) works and releases
+    _, shed = core.submit({"kind": "stream", "verb": "append",
+                           "id": 4, "session": sid,
+                           "history": history_to_edn(h)}, now)
+    assert shed["error"] == "shutting-down"
+    _, ckr = core.submit({"kind": "stream", "verb": "checkpoint",
+                          "id": 5, "session": sid, "release": True},
+                         now)
+    assert ckr["ok"] and ckr["checkpoint_bytes"] > 0
+    assert len(core.sessions) == 0 and core.drained()
+    # ...and restores on a fresh (new-owner) core with the verdict
+    # intact
+    core2 = VerifierCore(batch_cap=8)
+    _, mo = core2.submit({"kind": "stream", "verb": "open", "id": 6,
+                          "checkpoint": ckr["checkpoint"]}, now)
+    assert mo["ok"] and mo["migrated"], mo
+    assert core2.m["stream_migrations"] == 1
+    pm = core2.metrics_reply()["prometheus"]
+    for metric in ("ring_epoch", "stream_migrations",
+                   "checkpoint_bytes"):
+        assert metric in pm, metric
+
+
+# --- routed-client failure policy --------------------------------------------
+
+class _StubClient:
+    def __init__(self, fail=None):
+        self.calls = 0
+        self.fail = fail                   # None | OSError | reply
+
+    def check(self, history, **kw):
+        self.calls += 1
+        if isinstance(self.fail, Exception):
+            raise self.fail
+        if self.fail is not None:
+            raise ServiceError.from_reply(self.fail)
+        return {"ok": True, "valid": True}
+
+    def close(self):
+        pass
+
+
+def _two_node_routed(a, b):
+    rc = RoutedClient({"sut/verifier/0": a, "sut/verifier/1": b})
+    return rc
+
+
+def _key_owned_by(rc, owner):
+    for i in range(256):
+        key = f"k{i}"
+        if rc.ring.nodes_for(key)[0] == owner:
+            return key
+    raise AssertionError("no key hashed to the node")
+
+
+def test_blacklist_skips_dead_node_within_ttl():
+    a, b = _StubClient(fail=OSError("down")), _StubClient()
+    rc = _two_node_routed(a, b)
+    rc.blacklist_ttl_s = 0.2
+    key = _key_owned_by(rc, "sut/verifier/0")
+    assert rc._route(key, lambda c: c.check("h"))["ok"]
+    assert a.calls == 1 and rc.failovers == 1
+    # within the TTL the dead node is NOT re-dialed
+    assert rc._route(key, lambda c: c.check("h"))["ok"]
+    assert a.calls == 1
+    # after the TTL it gets another chance (it recovered)
+    a.fail = None
+    time.sleep(0.25)
+    assert rc._route(key, lambda c: c.check("h"))["ok"]
+    assert a.calls == 2
+
+
+def test_failover_honors_retry_after_ms():
+    """An overloaded owner parks for ITS OWN retry_after_ms hint and
+    the request fails over to the next ring node — previously only
+    the happy path backed off (and the walk would re-dial the
+    overloaded node on every request)."""
+    a = _StubClient(fail={"ok": False, "error": "overload",
+                          "retry_after_ms": 150})
+    b = _StubClient()
+    rc = _two_node_routed(a, b)
+    key = _key_owned_by(rc, "sut/verifier/0")
+    assert rc._route(key, lambda c: c.check("h"))["ok"]
+    assert a.calls == 1 and b.calls == 1
+    # parked: the hint window keeps the walk off the overloaded node
+    assert rc._route(key, lambda c: c.check("h"))["ok"]
+    assert a.calls == 1 and b.calls == 2
+    time.sleep(0.16)
+    a.fail = None
+    assert rc._route(key, lambda c: c.check("h"))["ok"]
+    assert a.calls == 2
+
+
+def test_shutting_down_reply_fails_over():
+    a = _StubClient(fail={"ok": False, "error": "shutting-down"})
+    b = _StubClient()
+    rc = _two_node_routed(a, b)
+    key = _key_owned_by(rc, "sut/verifier/0")
+    out = rc._route(key, lambda c: c.check("h"))
+    assert out["ok"] and b.calls == 1
+    assert rc.failovers == 1
+
+
+def test_refresh_parks_pinned_nodes_for_handoff(monkeypatch):
+    """A refresh that drops a node with streams PINNED to it must
+    park the warm client instead of closing it: a draining daemon
+    serves checkpoint handoffs only over already-open connections
+    (its listener is closed) — closing here would degrade the
+    O(carry) migration to a full retained-delta replay whenever any
+    unrelated routed request refreshes during the drain grace."""
+    a, b = _StubClient(), _StubClient()
+    closed = []
+    a.close = lambda: closed.append("a")
+    a.port, b.port = 7000, 7001
+    rc = _two_node_routed(a, b)
+    rc._disco = ("127.0.0.1", 5105, "sut/verifier", {})
+
+    class _FakePmux:
+        def __init__(self, *args, **kw):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def used(self):
+            return {"sut/verifier/1": 7001}
+
+    import comdb2_tpu.control.pmux as pmux_mod
+    monkeypatch.setattr(pmux_mod, "PmuxClient", _FakePmux)
+    rc._pin("sut/verifier/0")            # one open RoutedStream
+    added, removed = rc.refresh()
+    assert removed == ["sut/verifier/0"] and not closed
+    assert rc._parting["sut/verifier/0"] is a
+    assert "sut/verifier/0" not in rc.clients
+    # the pinned handle still resolves its daemon for the handoff
+    rs = RoutedStream.__new__(RoutedStream)
+    rs.routed, rs.node = rc, "sut/verifier/0"
+    assert rs._client() is a
+    # unpinned (migrated / closed): the parked client finally closes
+    rc._unpin("sut/verifier/0")
+    assert closed == ["a"] and not rc._parting
+
+
+def test_memo_overflow_leaves_checkpoint_replayable():
+    """An extend that overflows ``max_states`` latches the session
+    terminal-UNKNOWN, but the session stays checkpointable — the
+    extend-call log must record only the SUCCESSFUL extends, or
+    every restore of that checkpoint would replay the overflow and
+    raise (a spurious error instead of the latched verdict; on the
+    release-based migration path the session would be lost
+    outright)."""
+    from comdb2_tpu.models.memo import IncrementalMemo, MemoOverflow
+    from comdb2_tpu.models.model import MODELS
+
+    inc = IncrementalMemo(MODELS["cas-register"](), max_states=4)
+    inc.extend([("write", 1)], 1)
+    n_ok = inc.n_states
+    with pytest.raises(MemoOverflow):
+        inc.extend([("write", 2), ("write", 3), ("write", 4),
+                    ("write", 5)], 4)
+    ck = inc.checkpoint()
+    restored = IncrementalMemo.restore(MODELS["cas-register"](), ck)
+    assert restored.transitions == [("write", 1)]
+    assert restored.n_states == n_ok
+
+
+# --- wire codec --------------------------------------------------------------
+
+def test_checkpoint_wire_codec_roundtrip():
+    doc = {
+        "arr": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "flags": np.array([True, False]),
+        "tup": (1, ("cas", (0, 1)), None),
+        "table": [("write", 1), ("cas", (1, 2))],
+        "intkeys": {3: 7, 9: 1},
+        "nested": {"x": [np.int32(5), "s", 2.5]},
+    }
+    back = CK.from_wire(CK.to_wire(doc))
+    np.testing.assert_array_equal(back["arr"], doc["arr"])
+    assert back["arr"].dtype == np.int32
+    np.testing.assert_array_equal(back["flags"], doc["flags"])
+    assert back["tup"] == (1, ("cas", (0, 1)), None)
+    assert back["table"] == [("write", 1), ("cas", (1, 2))]
+    assert back["intkeys"] == {3: 7, 9: 1}
+    assert back["nested"]["x"][0] == 5
+
+
+# --- supervisor --------------------------------------------------------------
+
+def test_supervisor_policy_pure():
+    from comdb2_tpu.service.supervisor import desired_count
+
+    # idle stays put at the floor
+    assert desired_count(1, 0, 0, 0) == 1
+    # 10 s of backlog at the observed drain rate -> scale up
+    assert desired_count(1, 100, 10, 0) == 2
+    # capped at max
+    assert desired_count(4, 1000, 1, 0, max_daemons=4) == 4
+    # drained + no sessions -> scale down, floored at min
+    assert desired_count(2, 0, 10, 0) == 1
+    assert desired_count(1, 0, 10, 0) == 1
+    # session pressure scales up even with an empty queue
+    assert desired_count(1, 0, 10, 48, max_sessions=64) == 2
+    # resident sessions block scale-down (their carries live there)
+    assert desired_count(2, 0, 10, 60, max_sessions=64) == 2
+
+
+def test_supervisor_spawn_retire_reap_no_zombies():
+    """The lifecycle contract end to end: spawn a real daemon, scrape
+    it, retire it (drain -> wait), and verify the child is REAPED —
+    not a zombie (no init reaper in this container)."""
+    from comdb2_tpu.service.supervisor import Supervisor
+
+    # the spawned daemon forces the cpu backend through the config
+    # API (--backend cpu); the suite env already carries
+    # JAX_PLATFORMS=cpu for subprocesses
+    sup = Supervisor(pmux_port=None, min_daemons=1, max_daemons=2,
+                     daemon_args=["--backend", "cpu", "--no-prime",
+                                  "--frontier", "64"],
+                     drain_grace_s=3.0)
+    child = sup.spawn()
+    pid = child.proc.pid
+    try:
+        stats = sup.scrape()
+        assert stats and stats[0]["queue_depth"] == 0
+        summary = sup.beat()
+        assert summary["daemons"] == 1
+    finally:
+        sup.shutdown()
+    assert child.proc.returncode is not None
+    if os.path.exists(f"/proc/{pid}/stat"):
+        state = open(f"/proc/{pid}/stat").read().split()[2]
+        assert state != "Z", "retired daemon left a zombie"
+    assert sup.retired == 1 and len(sup.children) == 0

@@ -285,12 +285,19 @@ def test_manager_cap_and_eviction():
         mgr.open(now + 2)
     s1.append([O.invoke(0, "write", 1), O.ok(0, "write", 1)])
     assert mgr.carry_bytes() > 0
-    # sid1 idles out; sid2 was touched later
+    # sid1 idles out; sid2 was touched later. Eviction is
+    # checkpoint-not-replay (round 12): the carry frees, the host
+    # checkpoint stays, and the next get() restores transparently
     mgr.get(sid2, now + 9)
     evicted = mgr.evict_idle(now + 12)
     assert evicted == [sid1]
-    assert mgr.get(sid1) is None and len(mgr) == 1
+    assert len(mgr) == 1 and mgr.checkpoint_count() == 1
     assert mgr.evictions == 1
+    restored = mgr.get(sid1, now + 13)
+    assert restored is not None and mgr.restores == 1
+    out = restored.append([O.invoke(1, "read", None),
+                           O.Op(1, "ok", "read", 1)])
+    assert out["valid"] is True and out["checked_through"] == 4
 
 
 def test_eviction_forces_inflight_finalize():
