@@ -4,7 +4,7 @@ The framework's fragile invariants (exact sort-adjacency dedup,
 sentinel-mask frontier reads, (8,128) tiling, SMEM-per-grid-step
 budgets, shape bucketing) historically lived as prose in CLAUDE.md and
 were rediscovered via 40 s Mosaic compile failures or 38-minute wedged
-test suites. This package checks them *before* compile time, as three
+test suites. This package checks them *before* compile time, as six
 cooperating passes:
 
 - :mod:`.lint` — AST lint rules over ``comdb2_tpu/``, ``scripts/`` and
@@ -29,8 +29,22 @@ cooperating passes:
   interprocedural ``unbucketed-dispatch-site`` rule. The runtime half
   — observed-compile capture and the subset assertion — is
   :mod:`comdb2_tpu.utils.compile_guard`.
+- :mod:`.lifecycle` — pass 5a, the fleet lifecycle/ordering checker
+  (publish-before-ready, deregister-before-close, log-after-success,
+  release-in-finally, fresh-deadline-timestamp, wait-after-kill):
+  the orderings PR 12's review rounds fixed by hand, machine-checked.
+- :mod:`.dataflow` — pass 5b, the host↔device taint pass over the
+  serving plane (sync-readback-in-pump, per-item-transfer): the
+  ring's dispatch/finalize decoupling and the ~100 ms tunnel
+  round-trip discipline.
 - :func:`audit_suppressions` — the ``stale-suppression`` rule: a
   marker that no longer trips its rule is itself a finding.
+
+Every pass registers itself as a :class:`Pass` (``register_pass``);
+the staged runners and the stale-suppression audit enumerate the ONE
+registry, so a new pass is covered by the CLI timing lines, the raw
+re-scan audit and ``--changed`` automatically instead of by
+copy-paste.
 
 Per-line suppression: append ``# analysis: ignore[rule-id]`` (or a
 blanket ``# analysis: ignore``) to the flagged line. Each rule's
@@ -40,8 +54,9 @@ provenance is documented in ``docs/static_analysis.md``.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
-from typing import Iterable, List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import (Callable, Dict, Iterable, List, Optional,
+                    Sequence)
 
 #: directories (relative to the repo root) the default repo scan covers
 SCAN_ROOTS = ("comdb2_tpu", "scripts", "tests")
@@ -68,6 +83,61 @@ def repo_root() -> str:
     """The repository root (parent of the ``comdb2_tpu`` package)."""
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     return os.path.dirname(pkg)
+
+
+@dataclass(frozen=True)
+class Pass:
+    """One registered analyzer.
+
+    - ``scan_paths(paths)`` — findings over explicit files,
+      suppressions applied (the fixtures / ``--changed`` mode).
+    - ``raw_file(path, source)`` — per-file RAW findings (suppression
+      off) for the stale-suppression audit's marker re-scan; ``None``
+      for interprocedural passes.
+    - ``raw_paths(paths)`` — whole-set raw findings for passes whose
+      rules need the full call graph (used by the audit when no
+      precomputed raw was threaded in).
+    - ``repo_stage(ctx)`` — optional repo-wide override; ``ctx`` is
+      ``{"root", "files", "prod", "trace", "raw"}``, where ``prod``
+      excludes tests and the stage may deposit its raw findings in
+      ``ctx["raw"][name]`` so the audit reuses them (one call-graph
+      build per run). Default: ``scan_paths(ctx["files"])``.
+    """
+    name: str
+    scan_paths: Callable[[Sequence[str]], List["Finding"]]
+    raw_file: Optional[Callable[[str, str], List["Finding"]]] = None
+    raw_paths: Optional[Callable[[Sequence[str]],
+                                 List["Finding"]]] = None
+    repo_stage: Optional[Callable[[dict], List["Finding"]]] = None
+
+
+#: registration order of the built-in passes (stage order in runs)
+_PASS_ORDER = ("lint", "pallas-budget", "jaxpr-audit",
+               "compile-surface", "lifecycle", "dataflow")
+
+#: modules that self-register a Pass on import
+_PASS_MODULES = ("lint", "pallas_budget", "jaxpr_audit",
+                 "compile_surface", "lifecycle", "dataflow")
+
+_REGISTRY: Dict[str, Pass] = {}
+
+
+def register_pass(p: Pass) -> Pass:
+    """Called by each analyzer module at import time."""
+    _REGISTRY[p.name] = p
+    return p
+
+
+def passes() -> List[Pass]:
+    """Every registered pass, in stage order (importing the built-in
+    analyzer modules so they self-register)."""
+    import importlib
+
+    for m in _PASS_MODULES:
+        importlib.import_module(f".{m}", __name__)
+    ordered = [_REGISTRY[n] for n in _PASS_ORDER if n in _REGISTRY]
+    extras = [p for n, p in _REGISTRY.items() if n not in _PASS_ORDER]
+    return ordered + extras
 
 
 def suppressed(source_lines: Sequence[str], lineno: int,
@@ -139,21 +209,27 @@ def _markers(source: str):
 
 
 def audit_suppressions(paths: Iterable[str],
-                       surface_raw: Optional[List[Finding]] = None
+                       surface_raw: Optional[List[Finding]] = None,
+                       raw_by_pass: Optional[
+                           Dict[str, List[Finding]]] = None
                        ) -> List[Finding]:
     """The ``stale-suppression`` rule: an ``# analysis: ignore[...]``
     marker on a line that no longer trips that rule is itself a
-    finding — suppressions must not rot silently. Every file-level
+    finding — suppressions must not rot silently. Every registered
     pass contributes its RAW findings (suppression off), so a marker
     is live iff some raw finding of its rule id lands on its line.
     Stale-suppression findings are deliberately NOT suppressible
     (a blanket marker would otherwise vouch for itself).
 
-    ``surface_raw``: pre-computed raw ``unbucketed-dispatch-site``
-    findings — the repo-staged runner passes the compile-surface
-    stage's own raw scan so the interprocedural call graph is built
-    once per run, not twice."""
-    from . import compile_surface, jaxpr_audit, lint, pallas_budget
+    ``raw_by_pass``: pre-computed raw findings keyed by pass name —
+    the repo-staged runner threads each interprocedural stage's own
+    raw scan through so call graphs are built once per run, not
+    twice. ``surface_raw`` is the legacy spelling for the
+    compile-surface entry."""
+    all_passes = passes()
+    raw_by_pass = dict(raw_by_pass or {})
+    if surface_raw is not None:
+        raw_by_pass.setdefault("compile-surface", surface_raw)
 
     paths = [p for p in paths if os.path.exists(p)]
     raw: dict = {p: [] for p in paths}
@@ -171,21 +247,21 @@ def audit_suppressions(paths: Iterable[str],
     # whole-repo re-scan measured 3 s against 1.2 s for every other
     # AST pass combined)
     for p in marked:
-        raw[p] += lint.lint_file(p, srcs[p],
-                                 apply_suppressions=False)
-        raw[p] += pallas_budget.scan_file(p, srcs[p],
-                                          apply_suppressions=False)
-        raw[p] += jaxpr_audit.scan_file(p, srcs[p],
-                                        apply_suppressions=False)
+        for ps in all_passes:
+            if ps.raw_file is not None:
+                raw[p] += ps.raw_file(p, srcs[p])
     if marked:
-        if surface_raw is None:
-            # the full path set: the interprocedural rule needs the
-            # whole call graph even when only a few files carry
-            # markers
-            surface_raw = compile_surface.scan_files(
-                paths, apply_suppressions=False)
-        for f in surface_raw:
-            raw.setdefault(f.path, []).append(f)
+        for ps in all_passes:
+            if ps.raw_file is not None:
+                continue
+            findings = raw_by_pass.get(ps.name)
+            if findings is None and ps.raw_paths is not None:
+                # the full path set: an interprocedural rule needs
+                # the whole call graph even when only a few files
+                # carry markers
+                findings = ps.raw_paths(paths)
+            for f in findings or []:
+                raw.setdefault(f.path, []).append(f)
     out: List[Finding] = []
     for p in marked:
         if p not in srcs:
@@ -224,80 +300,96 @@ def _staged(stages) -> List[tuple]:
     return out
 
 
-def run_paths_staged(paths: Iterable[str]) -> List[tuple]:
-    """Every file-level pass over explicit paths — the mode the
-    seeded-violation fixtures use — as timed stages."""
-    from . import compile_surface, jaxpr_audit, lint, pallas_budget
+def filter_suppressed(findings: Iterable[Finding]) -> List[Finding]:
+    """Drop findings whose line carries a matching
+    ``# analysis: ignore`` marker (reads each flagged file once)."""
+    lines_of: dict = {}
+    out: List[Finding] = []
+    for f in findings:
+        if f.path not in lines_of:
+            try:
+                lines_of[f.path] = _read(f.path).splitlines()
+            except OSError:
+                lines_of[f.path] = []
+        if not suppressed(lines_of[f.path], f.line, f.rule):
+            out.append(f)
+    return out
 
+
+def run_paths_staged(paths: Iterable[str]) -> List[tuple]:
+    """Every registered pass over explicit paths — the mode the
+    seeded-violation fixtures and ``--changed`` use — as timed
+    stages, plus the stale-suppression audit."""
     paths = list(paths)
-    return _staged([
-        ("lint", lambda: lint.lint_files(paths)),
-        ("pallas-budget", lambda: pallas_budget.scan_files(paths)),
-        ("jaxpr-audit", lambda: jaxpr_audit.scan_files(paths)),
-        ("compile-surface", lambda: compile_surface.scan_files(paths)),
-        ("suppression-audit", lambda: audit_suppressions(paths)),
-    ])
+    stages = [(p.name, (lambda p=p: p.scan_paths(paths)))
+              for p in passes()]
+    stages.append(("suppression-audit",
+                   lambda: audit_suppressions(paths)))
+    return _staged(stages)
 
 
 def run_repo_staged(root: Optional[str] = None, *,
                     trace: bool = True) -> List[tuple]:
-    """The full repo-wide run as timed stages: lint over the scan
-    roots; the production Pallas budget table; the jaxpr recompile
-    audit (bucket-closure scan of the fuzz script and the driver,
-    plus — with ``trace`` — abstract traces of the engine entry
-    points); the compile-surface prover (pass 4: unbucketed-dispatch
-    scan of the production modules + eval_shape ladder witnesses);
-    and the stale-suppression audit."""
-    from . import compile_surface, jaxpr_audit, lint, pallas_budget
-
+    """The full repo-wide run as timed stages: every registered pass
+    (a pass's ``repo_stage`` override widens the file scan with its
+    repo-level obligations — the production Pallas budget table, the
+    bucket-closure scan and abstract entry-point traces, the
+    compile-surface prover's production-module scan plus eval_shape
+    ladder witnesses) and the stale-suppression audit, which reuses
+    any raw findings the stages deposited in the shared ctx."""
     root = root or repo_root()
     files = collect_files(root)
-    # pass 4's dispatch-site scan covers the production surface
+    # the interprocedural scans cover the production surface
     # (package + scripts); tests probe odd shapes on purpose
     prod = [p for p in files
             if "tests" not in p.replace("\\", "/").split("/")]
+    ctx = {"root": root, "files": files, "prod": prod,
+           "trace": trace, "raw": {}}
 
-    def jaxpr_stage():
-        out = jaxpr_audit.scan_files(
-            [os.path.join(root, "scripts", "fuzz_pallas_seg.py"),
-             os.path.join(root, "comdb2_tpu", "checker", "linear.py")])
-        out += jaxpr_audit.check_bucket_closure()
-        if trace:
-            out += jaxpr_audit.trace_entry_points()
-        return out
+    stages = []
+    for p in passes():
+        if p.repo_stage is not None:
+            stages.append((p.name, (lambda p=p: p.repo_stage(ctx))))
+        else:
+            stages.append((p.name,
+                           (lambda p=p: p.scan_paths(files))))
+    stages.append(("suppression-audit",
+                   lambda: audit_suppressions(
+                       files, raw_by_pass=ctx["raw"])))
+    return _staged(stages)
 
-    surface_raw: List[Finding] = []
 
-    def surface_stage():
-        # raw once: the stage filters suppressions itself and hands
-        # the raw findings to the audit (one call-graph build per run)
-        raw = compile_surface.scan_files(prod,
-                                         apply_suppressions=False)
-        surface_raw.extend(raw)
-        lines_of: dict = {}
-        out = []
-        for f in raw:
-            if f.path not in lines_of:
-                try:
-                    lines_of[f.path] = _read(f.path).splitlines()
-                except OSError:
-                    lines_of[f.path] = []
-            if not suppressed(lines_of[f.path], f.line, f.rule):
-                out.append(f)
-        if trace:
-            out += compile_surface.trace_witnesses()
-        return out
+def changed_files(ref: str = "HEAD",
+                  root: Optional[str] = None) -> List[str]:
+    """The ``--changed`` file set: ``.py`` files under the scan roots
+    (fixtures excluded) that differ from ``ref`` per
+    ``git diff --name-only`` plus untracked files. Raises
+    ``RuntimeError`` when git can't resolve the ref."""
+    import subprocess
 
-    return _staged([
-        ("lint", lambda: lint.lint_files(files)),
-        ("pallas-budget",
-         lambda: pallas_budget.scan_files(files)
-         + pallas_budget.check_production()),
-        ("jaxpr-audit", jaxpr_stage),
-        ("compile-surface", surface_stage),
-        ("suppression-audit",
-         lambda: audit_suppressions(files, surface_raw=surface_raw)),
-    ])
+    root = root or repo_root()
+    names: set = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others",
+                 "--exclude-standard"]):
+        res = subprocess.run(cmd, cwd=root, capture_output=True,
+                             text=True, timeout=60)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)}: {res.stderr.strip()}")
+        names.update(ln.strip() for ln in res.stdout.splitlines()
+                     if ln.strip())
+    out: List[str] = []
+    for name in sorted(names):
+        parts = name.replace("\\", "/").split("/")
+        if not name.endswith(".py") or parts[0] not in SCAN_ROOTS:
+            continue
+        if any(part in EXCLUDE_PARTS for part in parts):
+            continue
+        path = os.path.join(root, name)
+        if os.path.exists(path):
+            out.append(path)
+    return out
 
 
 def run_paths(paths: Iterable[str]) -> List[Finding]:
@@ -312,7 +404,8 @@ def run_repo(root: Optional[str] = None, *,
             for f in fs]
 
 
-__all__ = ["Finding", "SCAN_ROOTS", "audit_suppressions",
-           "collect_files", "repo_root", "run_paths",
+__all__ = ["Finding", "Pass", "SCAN_ROOTS", "audit_suppressions",
+           "changed_files", "collect_files", "filter_suppressed",
+           "passes", "register_pass", "repo_root", "run_paths",
            "run_paths_staged", "run_repo", "run_repo_staged",
            "suppressed"]

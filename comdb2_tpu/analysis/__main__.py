@@ -4,7 +4,9 @@ With no paths: the full repo-wide run (lint over comdb2_tpu/, scripts/
 and tests/; production Pallas budgets; jaxpr recompile audit; the
 compile-surface prover; the stale-suppression audit). With explicit
 paths: the file-level passes only — the mode the seeded violation
-fixtures (tests/fixtures/analysis/) use.
+fixtures (tests/fixtures/analysis/) use. ``--changed [REF]`` checks
+only the files that differ from a git ref (default HEAD) plus
+untracked files — the pre-commit hook's incremental mode.
 
 Exits non-zero when any finding survives suppression — including when
 ``--json`` writes the findings artifact (the artifact records the
@@ -20,7 +22,8 @@ import json
 import sys
 from typing import List
 
-from . import Finding, run_paths_staged, run_repo_staged
+from . import (Finding, changed_files, run_paths_staged,
+               run_repo_staged)
 
 
 def main(argv=None) -> int:
@@ -29,6 +32,10 @@ def main(argv=None) -> int:
         description="repo-wide static invariant checker")
     p.add_argument("paths", nargs="*",
                    help="explicit files to check (default: whole repo)")
+    p.add_argument("--changed", nargs="?", const="HEAD",
+                   default=None, metavar="REF",
+                   help="check only .py files changed vs REF "
+                        "(git diff + untracked; default HEAD)")
     p.add_argument("--no-trace", action="store_true",
                    help="skip the jaxpr/eval_shape abstract-trace "
                         "stages")
@@ -57,7 +64,31 @@ def main(argv=None) -> int:
             fh.write(compile_surface.render_programs())
         print(f"program inventory written: {args.programs}")
 
-    if args.paths:
+    if args.changed is not None and args.paths:
+        p.error("--changed and explicit paths are mutually exclusive")
+    if args.json_out:
+        import os
+        if any(os.path.realpath(args.json_out) == os.path.realpath(pp)
+               for pp in args.paths):
+            p.error("--json PATH is the findings artifact to WRITE — "
+                    "it matches one of the files under check")
+    if args.changed is not None:
+        try:
+            paths = changed_files(args.changed)
+        except RuntimeError as exc:
+            print(f"--changed: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            if args.json_out:
+                with open(args.json_out, "w") as fh:
+                    json.dump([], fh)
+            print(f"OK: 0 findings (no files changed vs "
+                  f"{args.changed})")
+            return 0
+        print(f"--changed {args.changed}: {len(paths)} file(s)",
+              file=sys.stderr)
+        stages = run_paths_staged(paths)
+    elif args.paths:
         stages = run_paths_staged(args.paths)
     else:
         stages = run_repo_staged(trace=not args.no_trace)

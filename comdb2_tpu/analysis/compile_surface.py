@@ -1154,3 +1154,27 @@ def scan_files(paths: Sequence[str], *,
 __all__ = ["Axis", "Inventory", "Site", "SHAPE_SINKS",
            "static_inventory", "render_programs", "scan_files",
            "trace_witnesses", "witness_table"]
+
+
+from . import Pass, filter_suppressed, register_pass
+
+
+def _repo_stage(ctx):
+    # raw once: the stage filters suppressions itself and deposits
+    # the raw findings for the stale-suppression audit (one
+    # call-graph build per run, not two)
+    raw = scan_files(ctx["prod"], apply_suppressions=False)
+    ctx["raw"]["compile-surface"] = raw
+    out = filter_suppressed(raw)
+    if ctx["trace"]:
+        out += trace_witnesses()
+    return out
+
+
+register_pass(Pass(
+    name="compile-surface",
+    scan_paths=scan_files,
+    raw_paths=lambda paths: scan_files(paths,
+                                       apply_suppressions=False),
+    repo_stage=_repo_stage,
+))

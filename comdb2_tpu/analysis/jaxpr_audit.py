@@ -268,3 +268,28 @@ def trace_entry_points(
                     "shared tier unconditionally and select with ONE "
                     "cond"))
     return out
+
+
+from . import Pass, register_pass
+
+
+def _repo_stage(ctx):
+    # bucket-closure scan of the fuzz script and the chunked driver,
+    # plus (with trace) abstract traces of the engine entry points
+    out = scan_files(
+        [os.path.join(ctx["root"], "scripts", "fuzz_pallas_seg.py"),
+         os.path.join(ctx["root"], "comdb2_tpu", "checker",
+                      "linear.py")])
+    out += check_bucket_closure()
+    if ctx["trace"]:
+        out += trace_entry_points()
+    return out
+
+
+register_pass(Pass(
+    name="jaxpr-audit",
+    scan_paths=scan_files,
+    raw_file=lambda path, source: scan_file(
+        path, source, apply_suppressions=False),
+    repo_stage=_repo_stage,
+))

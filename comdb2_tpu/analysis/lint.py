@@ -605,3 +605,13 @@ def lint_files(paths) -> List[Finding]:
     for p in paths:
         out += lint_file(p)
     return out
+
+
+from . import Pass, register_pass
+
+register_pass(Pass(
+    name="lint",
+    scan_paths=lint_files,
+    raw_file=lambda path, source: lint_file(
+        path, source, apply_suppressions=False),
+))

@@ -351,3 +351,19 @@ def scan_files(paths: Sequence[str]) -> List[Finding]:
         if os.path.exists(p):
             out += scan_file(p)
     return out
+
+
+from . import Pass, register_pass
+
+
+def _repo_stage(ctx):
+    return scan_files(ctx["files"]) + check_production()
+
+
+register_pass(Pass(
+    name="pallas-budget",
+    scan_paths=scan_files,
+    raw_file=lambda path, source: scan_file(
+        path, source, apply_suppressions=False),
+    repo_stage=_repo_stage,
+))

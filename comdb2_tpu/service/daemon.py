@@ -433,6 +433,10 @@ class VerifierDaemon:
         """Answer nothing new, flush queued requests as unknown, close
         every socket — a clean exit, never a hang with clients blocked
         on reads."""
+        # withdraw FIRST: clients re-route on the epoch bump, so the
+        # ring must stop advertising this node before its listener
+        # starts refusing connects (rule deregister-before-close)
+        self._pmux_withdraw()
         for p, reply in self.core.tick(obs.monotonic()):
             self._send(p.ctx, reply)
         for conn in list(self._conns.values()):
@@ -443,7 +447,6 @@ class VerifierDaemon:
             pass
         self._lsock.close()
         self._sel.close()
-        self._pmux_withdraw()
         if self.store_root is not None:
             self._save_artifact()
 

@@ -133,9 +133,14 @@ class StreamSession:
         return self._verdict_map()
 
     def close(self) -> dict:
-        """Finalize, release the device carry, reject further work."""
-        out = self.finalize_input()
-        self.release()
+        """Finalize, release the device carry, reject further work.
+        The release rides ``finally``: a finalize that raises (engine
+        error, rung re-route failure) must still free the carry, or
+        the session leaks device memory until idle eviction."""
+        try:
+            out = self.finalize_input()
+        finally:
+            self.release()
         return out
 
     def release(self) -> None:

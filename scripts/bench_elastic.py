@@ -63,9 +63,17 @@ DAEMON_ARGS = ["--backend", "cpu", "--no-prime", "--frontier", "64",
 
 
 def zombies() -> int:
-    out = subprocess.run(["ps", "-eo", "stat="], capture_output=True,
-                         text=True).stdout
-    return sum(1 for ln in out.splitlines() if ln.strip().startswith("Z"))
+    """Unreaped children of THIS process. Scoped to our own pid
+    because the gate means "the bench reaped everything it spawned" —
+    a system-wide Z count is racy (LeakSanitizer's exit-time tracer
+    briefly shows as a Z child of the dying ASan ct_pmux, which is
+    the sanitizer runtime's corpse to collect, not ours)."""
+    me = str(os.getpid())
+    out = subprocess.run(["ps", "-eo", "ppid=,stat="],
+                         capture_output=True, text=True).stdout
+    return sum(1 for ln in out.splitlines()
+               if ln.split()[:1] == [me]
+               and ln.split()[1].startswith("Z"))
 
 
 def req_history(i: int):
@@ -154,7 +162,11 @@ def main() -> int:
         served_before_kill = None
         for i, text in enumerate(texts):
             if i == kill_at:
-                victim.proc.kill()        # no drain, no deregister
+                # the nemesis: SIGKILL with no drain and no reap HERE
+                # — the supervisor's beat() poll()s and reaps the
+                # corpse; waiting here would serialize the fault with
+                # the burst we are measuring under
+                victim.proc.kill()        # no drain, no deregister  # analysis: ignore[wait-after-kill]
                 served_before_kill = dict(rc.served)
             drive(i, text)
             if i % 4 == 3:
@@ -329,6 +341,17 @@ def main() -> int:
         fh.write(line + "\n")
     if not out["gate_ok"]:
         print("FAIL: elastic gate", file=sys.stderr)
+        return 1
+    # artifact hygiene: the supervised fleet wrote stores/registrations
+    # all over the tree — the static-analysis verdict must stay clean
+    # post-run (subprocess so the verdict is independent of this
+    # process's jax/import state)
+    r = subprocess.run(
+        [sys.executable, "-m", "comdb2_tpu.analysis", "--no-trace"],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        print("FAIL: static analysis not clean post-run:\n"
+              f"{r.stdout}{r.stderr}", file=sys.stderr)
         return 1
     return 0
 

@@ -105,6 +105,7 @@ def start_pmux(binary: str, port: int):
         except OSError:
             time.sleep(0.1)
     proc.kill()
+    proc.wait(timeout=30)   # no init reaper: reap before raising
     raise SystemExit("ct_pmux never came up")
 
 

@@ -281,6 +281,23 @@ def check_overload_burst(backend, text):
         stop_daemon(proc, port)
 
 
+def _analysis_clean() -> bool:
+    """Artifact hygiene: the bench spawns daemons that write into the
+    store dir and the repo tree — the static-analysis verdict must
+    stay clean POST-run, so a finding introduced by generated files
+    fails the bench loudly instead of rotting until the next tier-1
+    run. Subprocess: the checker's verdict must not depend on this
+    process's jax/import state."""
+    r = subprocess.run(
+        [sys.executable, "-m", "comdb2_tpu.analysis", "--no-trace"],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        print("FAIL: static analysis not clean post-run:\n"
+              f"{r.stdout}{r.stderr}", file=sys.stderr)
+        return False
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
@@ -506,6 +523,8 @@ def main() -> int:
         print(f"FAIL: speedup {speedup:.2f} < derived floor "
               f"{speedup_floor:.2f} (ideal {ideal:.2f})",
               file=sys.stderr)
+        rc = 1
+    if not _analysis_clean():
         rc = 1
     return rc
 

@@ -67,6 +67,31 @@ run() {
     fi
 }
 
+zombie_count() {
+    ps -eo stat= | grep -c '^Z' || true
+}
+
+# The zombie accounting is system-wide, so it can TRANSIENTLY exceed
+# the stage baseline for reasons outside the process tree under test —
+# LeakSanitizer's exit-time tracer briefly shows as a Z child of a
+# dying ASan ct_pmux until init lazily reaps the orphan. A leak is a
+# count that STAYS elevated: give the table a settle window before
+# declaring one. Prints the settled count; exits non-zero if still
+# above baseline after ~5s.
+zombies_settled() {    # zombies_settled BASELINE
+    local base=$1 now=0
+    for _ in $(seq 50); do
+        now=$(zombie_count)
+        if [ "$now" -le "$base" ]; then
+            echo "$now"
+            return 0
+        fi
+        sleep 0.1
+    done
+    echo "$now"
+    return 1
+}
+
 stage analysis "static invariant checker"
 run python -m comdb2_tpu.analysis
 
@@ -146,6 +171,60 @@ cat <&3 >/dev/null || true
 exec 3<&- 3>&-
 wait "$PMUX_PID"   # non-zero (ASan abort) fails the check
 CLEANUP_PIDS=""
+
+stage tsan "ct_pmux start/serve/shutdown under TSan"
+# the other half of CT_SANITIZE: the registry serves every connection
+# on its own thread, so the races ASan can't see (registry map vs
+# serve threads, shutdown drain vs in-flight handlers) only surface
+# under TSan — and only with CONCURRENT clients, so the smoke drives
+# eight at once before the shutdown
+if command -v cmake >/dev/null; then
+    cmake -DCT_SANITIZE=thread -S native -B native/build-tsan \
+        >/dev/null
+    cmake --build native/build-tsan -j"$(nproc)" >/dev/null
+else
+    [ "$JSON_MODE" = 1 ] || \
+        echo "cmake not found — direct g++ TSan build of ct_pmux"
+    mkdir -p native/build-tsan
+    g++ -fsanitize=thread -fno-omit-frame-pointer -g -Wall -Wextra \
+        -Inative/include native/src/pmux_main.cpp \
+        -o native/build-tsan/ct_pmux -lpthread
+fi
+TSAN_PMUX=native/build-tsan/ct_pmux
+TSAN_PORT=${CT_CHECK_TSAN_PMUX_PORT:-15107}
+TSAN_STATE=$(mktemp)
+# halt_on_error: a reported race aborts non-zero and fails the wait
+TSAN_OPTIONS=halt_on_error=1 "$TSAN_PMUX" -p "$TSAN_PORT" \
+    -f "$TSAN_STATE" &
+TSAN_PID=$!
+CLEANUP_PIDS="$TSAN_PID"
+for _ in $(seq 50); do
+    if bash -c "true >/dev/tcp/127.0.0.1/$TSAN_PORT" 2>/dev/null; then
+        break
+    fi
+    sleep 0.1
+done
+TSAN_CLIENTS=""
+for i in $(seq 8); do
+    (
+        exec 3<>"/dev/tcp/127.0.0.1/$TSAN_PORT"
+        printf "reg sut/tsan%s\nget sut/tsan%s\ndel sut/tsan%s\n" \
+            "$i" "$i" "$i" >&3
+        head -3 <&3 >/dev/null
+        exec 3<&- 3>&-
+    ) &
+    TSAN_CLIENTS="$TSAN_CLIENTS $!"
+done
+for pid in $TSAN_CLIENTS; do
+    wait "$pid"
+done
+exec 3<>"/dev/tcp/127.0.0.1/$TSAN_PORT"
+printf 'exit\n' >&3
+cat <&3 >/dev/null || true
+exec 3<&- 3>&-
+wait "$TSAN_PID"   # non-zero (TSan race report) fails the check
+CLEANUP_PIDS=""
+rm -f "$TSAN_STATE"
 
 stage txn-smoke "txn serializability checker smoke (host engine)"
 # the seeded G2 write-skew fixture MUST be caught (exit 1 = invalid);
@@ -245,7 +324,7 @@ stage service-smoke "verifier service smoke (CPU backend)"
 # below must catch NEW zombies (a reaped child can't show Z, so the
 # meaningful assertion is "no more Z states than before, and no
 # surviving service process")
-ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+ZOMBIES_BEFORE=$(zombie_count)
 SVC_LOG=$(mktemp)
 JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
     --backend cpu --no-prime --frontier 64 >"$SVC_LOG" 2>&1 &
@@ -290,8 +369,7 @@ if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
     echo "verifier daemon left a process behind" >&2
     exit 1
 fi
-ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
-if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+if ! ZOMBIES_AFTER=$(zombies_settled "$ZOMBIES_BEFORE"); then
     echo "verifier daemon left a zombie" \
          "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
     exit 1
@@ -302,7 +380,7 @@ stage stream "streaming verification sessions smoke (kind:\"stream\")"
 # session, append a clean delta (valid-so-far), append a violating
 # delta (INVALID latches — later appends answer immediately), close,
 # clean shutdown, no zombies
-ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+ZOMBIES_BEFORE=$(zombie_count)
 STRM_LOG=$(mktemp)
 JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
     --backend cpu --no-prime --frontier 256 \
@@ -359,8 +437,7 @@ if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
     echo "stream daemon left a process behind" >&2
     exit 1
 fi
-ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
-if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+if ! ZOMBIES_AFTER=$(zombies_settled "$ZOMBIES_BEFORE"); then
     echo "stream daemon left a zombie" \
          "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
     exit 1
@@ -373,7 +450,7 @@ stage routing "pmux-routed two-daemon fleet smoke"
 # client discovers them and routes 8 mixed-shape requests — BOTH
 # daemons must serve traffic, and everything must shut down clean
 # with no zombies (docs/service.md "Horizontal scale-out")
-ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+ZOMBIES_BEFORE=$(zombie_count)
 RT_PMUX_PORT=${CT_CHECK_ROUTING_PMUX_PORT:-15106}
 ASAN_OPTIONS=halt_on_error=1 "$PMUX" -p "$RT_PMUX_PORT" &
 RT_PMUX_PID=$!
@@ -441,8 +518,7 @@ if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
     echo "routing smoke left a daemon behind" >&2
     exit 1
 fi
-ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
-if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+if ! ZOMBIES_AFTER=$(zombies_settled "$ZOMBIES_BEFORE"); then
     echo "routing smoke left a zombie" \
          "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
     exit 1
@@ -459,15 +535,14 @@ stage elastic "elastic fleet smoke (supervisor, SIGKILL nemesis, join, migration
 # and the client-observed fleet history is checked VALID by the
 # fleet itself. Zombie accounting shell-side too: the supervisor
 # must reap every child (no init reaper in this container).
-ZOMBIES_BEFORE=$(ps -eo stat= | grep -c '^Z' || true)
+ZOMBIES_BEFORE=$(zombie_count)
 run env JAX_PLATFORMS=cpu python scripts/bench_elastic.py --quick \
     --out /tmp/bench_elastic_smoke.json
 if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
     echo "elastic smoke left a daemon behind" >&2
     exit 1
 fi
-ZOMBIES_AFTER=$(ps -eo stat= | grep -c '^Z' || true)
-if [ "$ZOMBIES_AFTER" -gt "$ZOMBIES_BEFORE" ]; then
+if ! ZOMBIES_AFTER=$(zombies_settled "$ZOMBIES_BEFORE"); then
     echo "elastic smoke left a zombie" \
          "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
     exit 1
@@ -542,7 +617,8 @@ rm -rf "$OBS_STORE" "$OBS_LOG"
 stage_end_ok
 if [ "$JSON_MODE" = 0 ]; then
     echo "OK: checker clean, ASan build clean, native static" \
-         "analysis clean, ct_pmux shutdown clean, txn smoke caught" \
+         "analysis clean, ct_pmux shutdown clean under ASan and TSan" \
+         "(8 concurrent clients), txn smoke caught" \
          "the seeded cycle, shrink smoke reached the known minimum," \
          "mxu smoke answered both wide-P fixtures," \
          "multichip dryrun bit-identical across the mesh," \

@@ -1,0 +1,13 @@
+"""Seeded violation: listener closed before the pmux withdraw/epoch
+bump (rule ``deregister-before-close``).
+
+Clients re-route on the epoch bump. A listener closed first turns
+every in-flight ring walk into a connect error against a node the
+ring still advertises — the exact ordering PR 12's drain review fixed
+in ``daemon._shutdown`` (withdraw FIRST, then stop accepting)."""
+
+
+def _shutdown(self):
+    self._lsock.close()          # finding: close before deregister
+    self._pmux_withdraw()
+    self._sel.close()

@@ -1,0 +1,13 @@
+"""Seeded violation: child killed and never waited (rule
+``wait-after-kill``).
+
+This container has no init reaper: a ``kill()``/``terminate()`` with
+no later ``wait()`` on the SAME process leaves a zombie forever — the
+pid table leaks and ``kill -0``-style liveness probes keep answering
+"alive" for a corpse (check ``ps -o stat=`` for ``Z``)."""
+
+
+def retire(self, worker):
+    self._deregister(worker.name)
+    worker.proc.kill()                   # finding: no wait() follows
+    self._workers.remove(worker)

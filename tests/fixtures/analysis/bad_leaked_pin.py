@@ -1,0 +1,12 @@
+"""Seeded violation: session pin released outside ``try/finally`` on
+a cleanup path (rule ``release-in-finally``).
+
+A ``close`` that raises before its ``_unpin`` leaks the affinity pin
+forever: failover never re-routes the session and idle eviction never
+fires — the PR-12 failed-close pin leak, machine-checked."""
+
+
+def close(self, session):
+    out = self._finalize(session)        # may raise (rung re-route)
+    self._unpin(session.key)             # finding: not in finally
+    return out

@@ -1,0 +1,14 @@
+"""Seeded violation: replay log appended before the guarded call
+succeeded (rule ``log-after-success``).
+
+The stream client's retained-delta log and ``IncrementalMemo``'s
+extend log are REPLAYED on failover/restore: an entry recorded before
+the send/extend succeeds makes every replay repeat the failure (or
+double-apply a delta the server never acked)."""
+
+
+def append(self, session, payload):
+    seq = self._next_seq(session)
+    self._delta_log.append((seq, payload))   # finding: log first
+    self._send(session.node, seq, payload)
+    return seq

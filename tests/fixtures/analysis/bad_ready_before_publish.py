@@ -1,0 +1,15 @@
+"""Seeded violation: daemon ready line emitted before the pmux
+registration (rule ``publish-before-ready``).
+
+"ready" must mean DISCOVERABLE: the supervisor (and bench harnesses)
+route to the daemon the moment the ready line appears, so printing it
+before ``publish`` races them against a ring that cannot see the node
+yet — and a crash between the two leaves a client-visible server
+discovery never lists."""
+
+
+def serve(pmux, lsock, shard):
+    port = lsock.getsockname()[1]
+    print("ready", port, flush=True)   # finding: ready before publish
+    pmux.publish(f"sut/verifier/{shard}", port)
+    return port

@@ -1,0 +1,19 @@
+"""Seeded violation: blacklist deadline anchored at loop-entry time
+(rule ``fresh-deadline-timestamp``).
+
+A hung connect burns its whole timeout before raising, so a TTL
+computed from the timestamp taken BEFORE the ring walk is already
+(mostly) expired when stored — the dead node is never actually
+avoided. Stamp deadlines where they are stored."""
+
+from comdb2_tpu.obs.trace import monotonic
+
+
+def route(self, shape_class):
+    now = monotonic()
+    for name in self._ring:
+        try:
+            return self._connect(name, shape_class)
+        except OSError:
+            self._avoid[name] = now + self._ttl_s   # finding: stale
+    raise OSError("ring exhausted")

@@ -1,0 +1,17 @@
+"""Seeded violation: blocking device readback inside the scheduler
+beat (rule ``sync-readback-in-pump``).
+
+``pump`` is the serving loop's beat: it must stage (upload + launch)
+and hand the dispatch to the bounded ring, whose deferred finalize
+closures do the readback later. An ``np.asarray`` of the engine
+result inside pump serializes the beat on the ~100 ms tunnel
+round-trip instead of overlapping it with the next bucket's pack."""
+
+import numpy as np
+
+
+def pump(self, now):
+    batch = self._take_bucket(now)
+    res = check_device_batch(batch, n_states=64, n_transitions=128)
+    verdicts = np.asarray(res)           # finding: sync readback
+    self._answer(batch, verdicts)

@@ -19,7 +19,8 @@ import sys
 import pytest
 
 from comdb2_tpu import analysis
-from comdb2_tpu.analysis import jaxpr_audit, lint, pallas_budget
+from comdb2_tpu.analysis import (dataflow, jaxpr_audit, lifecycle, lint,
+                                 pallas_budget)
 
 REPO = analysis.repo_root()
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
@@ -49,6 +50,14 @@ FIXTURE_RULES = {
     "bad_vmap_sharded_route.py": "vmap-sharded-oracle",
     "bad_stale_suppression.py": "stale-suppression",
     "bad_raw_clock_dispatch.py": "raw-clock-in-pipeline",
+    "bad_ready_before_publish.py": "publish-before-ready",
+    "bad_close_before_deregister.py": "deregister-before-close",
+    "bad_log_before_success.py": "log-after-success",
+    "bad_leaked_pin.py": "release-in-finally",
+    "bad_stale_ttl_timestamp.py": "fresh-deadline-timestamp",
+    "bad_kill_no_wait.py": "wait-after-kill",
+    "bad_sync_readback_pump.py": "sync-readback-in-pump",
+    "bad_per_item_transfer.py": "per-item-transfer",
 }
 
 
@@ -76,8 +85,8 @@ def test_fixture_inventory_matches_readme():
     on_disk = {f for f in os.listdir(FIXTURES) if f.endswith(".py")}
     assert on_disk == set(FIXTURE_RULES), \
         "fixtures/analysis/ and FIXTURE_RULES drifted apart"
-    # the acceptance floor: >= 16 fixtures across the pass families
-    assert len(FIXTURE_RULES) >= 16
+    # the acceptance floor: >= 30 fixtures across the pass families
+    assert len(FIXTURE_RULES) >= 30
 
 
 @pytest.mark.parametrize("fixture,rule", sorted(FIXTURE_RULES.items()))
@@ -268,7 +277,8 @@ def test_cli_reports_per_pass_timing():
     stderr."""
     r = _run_cli(os.path.join(FIXTURES, "bad_multiprocessing.py"))
     for name in ("lint", "pallas-budget", "jaxpr-audit",
-                 "compile-surface", "suppression-audit"):
+                 "compile-surface", "lifecycle", "dataflow",
+                 "suppression-audit"):
         assert f"pass {name}:" in r.stderr, r.stderr
 
 
@@ -278,3 +288,249 @@ def test_cli_programs_artifact(tmp_path):
                  os.path.join(FIXTURES, "bad_multiprocessing.py"))
     assert r.returncode == 1            # the fixture still fails
     assert progs.read_text().startswith("# Compile-surface inventory")
+
+
+# --- pass 5: lifecycle & dataflow ---------------------------------------------
+
+#: the pass-5 rule ids (lifecycle + dataflow)
+PASS5_RULES = {"publish-before-ready", "deregister-before-close",
+               "log-after-success", "release-in-finally",
+               "fresh-deadline-timestamp", "wait-after-kill",
+               "sync-readback-in-pump", "per-item-transfer"}
+
+
+def _pass5_rules(path):
+    return ({f.rule for f in lifecycle.scan_file(path)}
+            | {f.rule for f in dataflow.scan_files([path])})
+
+
+@pytest.mark.parametrize("fixture,rule", sorted(FIXTURE_RULES.items()))
+def test_pass5_rules_exclusive(fixture, rule):
+    """The acceptance gate's exclusivity half: each pass-5 fixture
+    trips exactly its own pass-5 rule, and NO pre-existing fixture
+    trips any pass-5 rule (a cross-rule false positive on the seeded
+    corpus would mean the new analyzers over-match)."""
+    fired = _pass5_rules(os.path.join(FIXTURES, fixture))
+    if rule in PASS5_RULES:
+        assert fired == {rule}, (fixture, fired)
+    else:
+        assert fired == set(), (fixture, fired)
+
+
+#: (tag, rule, pre-fix excerpt, post-fix excerpt) — the PR-12
+#: review-round bugs, reproduced from the pre-fix code shape so the
+#: rules provably catch what the reviews caught by hand
+PR12_EXCERPTS = [
+    ("shutdown-close-order", "deregister-before-close",
+     # service/daemon.py pre-fix: listener closed before the withdraw
+     '''
+class D:
+    def _shutdown(self):
+        for p, reply in self.core.tick(monotonic()):
+            self._send(p.ctx, reply)
+        self._lsock.close()
+        self._sel.close()
+        self._pmux_withdraw()
+''',
+     '''
+class D:
+    def _shutdown(self):
+        self._pmux_withdraw()
+        for p, reply in self.core.tick(monotonic()):
+            self._send(p.ctx, reply)
+        self._lsock.close()
+        self._sel.close()
+'''),
+    ("memo-log-order", "log-after-success",
+     # models/memo.py pre-fix: the extend-call log appended BEFORE the
+     # closure ran — a MemoOverflow mid-extend poisoned every restore
+     '''
+class IncrementalMemo:
+    def extend(self, ops):
+        self._log.append(tuple(ops))
+        self._closure(ops)
+        self._depth += len(ops)
+''',
+     '''
+class IncrementalMemo:
+    def extend(self, ops):
+        self._closure(ops)
+        self._depth += len(ops)
+        self._log.append(tuple(ops))
+'''),
+    ("stream-close-pin-leak", "release-in-finally",
+     # client.py pre-fix: a close whose failover also failed leaked
+     # the pin (the node's client parked in _parting forever)
+     '''
+class RoutedStream:
+    def close(self):
+        out = self._client.stream_close(self.sid)
+        self._router._unpin(self._node)
+        return out
+''',
+     '''
+class RoutedStream:
+    def close(self):
+        try:
+            out = self._client.stream_close(self.sid)
+        finally:
+            self._router._unpin(self._node)
+        return out
+'''),
+    ("route-stale-ttl", "fresh-deadline-timestamp",
+     # client.py pre-fix: blacklist TTL anchored at walk start — a
+     # hung connect burned the timeout, so the deadline was already
+     # expired when written and the node got re-dialed hot
+     '''
+def _route(self, cls):
+    now = monotonic()
+    for name in self._ring.walk(cls):
+        try:
+            return self._dial(name)
+        except OSError:
+            self._blacklist[name] = now + self.blacklist_ttl_s
+    return None
+''',
+     '''
+def _route(self, cls):
+    for name in self._ring.walk(cls):
+        try:
+            return self._dial(name)
+        except OSError:
+            self._blacklist[name] = monotonic() + self.blacklist_ttl_s
+    return None
+'''),
+]
+
+
+@pytest.mark.parametrize("tag,rule,bad,good",
+                         PR12_EXCERPTS,
+                         ids=[e[0] for e in PR12_EXCERPTS])
+def test_pass5_reproduces_pr12_review_bugs(tag, rule, bad, good):
+    """The acceptance gate's reproduction half: reverting >= 3 of the
+    PR-12 review-round fixes (as faithful pre-fix code excerpts) makes
+    the matching rule fire, and each post-fix twin is clean — the
+    rules encode exactly the orderings the reviews fixed by hand."""
+    fired = [f.rule for f in lifecycle.scan_file("<mem>.py", bad)]
+    assert fired == [rule], (tag, fired)
+    assert lifecycle.scan_file("<mem>.py", good) == [], tag
+
+
+def test_dataflow_deferred_finalize_exempt(tmp_path):
+    """The ring's contract: readbacks in the DEFERRED finalize closure
+    a hot path stages are the sanctioned pattern — only an inline
+    readback on the beat itself is a finding."""
+    inline = tmp_path / "inline_dispatch.py"
+    inline.write_text(
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def pump(core):\n"
+        "    out = jnp.sum(core.buf)\n"
+        "    return np.asarray(out)\n")
+    deferred = tmp_path / "deferred_dispatch.py"
+    deferred.write_text(
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def pump(core):\n"
+        "    out = jnp.sum(core.buf)\n"
+        "    def finalize():\n"
+        "        return np.asarray(out)\n"
+        "    core.ring.append(finalize)\n")
+    assert [f.rule for f in dataflow.scan_files([str(inline)])] == \
+        ["sync-readback-in-pump"]
+    assert dataflow.scan_files([str(deferred)]) == []
+
+
+def test_pass5_suppression_live_and_stale(tmp_path):
+    """The suppression-audit path for BOTH pass-5 analyzers: a live
+    marker suppresses its finding without becoming stale (dataflow's
+    whole-set raw_paths re-scan), and a marker on a clean line is a
+    stale-suppression finding (lifecycle's per-file raw_file
+    re-scan)."""
+    live = tmp_path / "pump_dispatch.py"
+    live.write_text(
+        "import jax.numpy as jnp\n"
+        "def pump(core):\n"
+        "    x = jnp.sum(core.buf)\n"
+        "    return float(x)"
+        "  # analysis: ignore[sync-readback-in-pump]\n")
+    stale = tmp_path / "svc_dispatch.py"
+    stale.write_text(
+        "def retire(proc):\n"
+        "    proc.terminate()\n"
+        "    proc.wait()  # analysis: ignore[wait-after-kill]\n")
+    # the live marker suppresses, and the audit does not flag it
+    assert analysis.run_paths([str(live)]) == []
+    assert analysis.audit_suppressions([str(live)]) == []
+    # the stale marker survives no rule and IS the finding
+    fired = [f.rule for f in analysis.run_paths([str(stale)])]
+    assert fired == ["stale-suppression"], fired
+
+
+def test_pass5_json_exit_code(tmp_path):
+    """``--json`` over a pass-5 fixture: non-zero exit with the rule
+    in the artifact (the artifact records the failure, it never
+    absorbs it)."""
+    import json
+
+    out = tmp_path / "findings.json"
+    r = _run_cli("--json", str(out),
+                 os.path.join(FIXTURES, "bad_sync_readback_pump.py"))
+    assert r.returncode == 1
+    rules = {f["rule"] for f in json.loads(out.read_text())}
+    assert "sync-readback-in-pump" in rules, rules
+
+
+# --- --changed incremental mode ----------------------------------------------
+
+def _git(root, *args):
+    subprocess.run(["git", "-c", "user.email=t@t.invalid",
+                    "-c", "user.name=t", *args],
+                   cwd=root, check=True, capture_output=True)
+
+
+def test_changed_mode_agrees_with_full_run(tmp_path):
+    """The acceptance gate: over a touched-file subset, the
+    incremental ``--changed`` file set produces exactly the findings
+    the full run attributes to those files — modified-tracked and
+    untracked files are both in, committed-clean files are out."""
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "scripts"))
+    _git(root, "init", "-q")
+    clean = os.path.join(root, "scripts", "clean.py")
+    with open(clean, "w") as fh:
+        fh.write("x = 1\n")
+    tracked = os.path.join(root, "scripts", "svc_dispatch.py")
+    with open(tracked, "w") as fh:
+        fh.write("def retire(p):\n    p.terminate()\n    p.wait()\n")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    # revert the wait-after-kill fix in the tracked file...
+    with open(tracked, "w") as fh:
+        fh.write("def retire(p):\n    p.terminate()\n")
+    # ... and add an untracked file with a per-item transfer loop
+    new = os.path.join(root, "scripts", "xfer_dispatch.py")
+    with open(new, "w") as fh:
+        fh.write("import jax\ndef push(items):\n"
+                 "    for it in items:\n        jax.device_put(it)\n")
+    changed = analysis.changed_files("HEAD", root=root)
+    assert sorted(os.path.basename(p) for p in changed) == \
+        ["svc_dispatch.py", "xfer_dispatch.py"]
+    inc = {(os.path.basename(f.path), f.rule)
+           for f in analysis.run_paths(changed)}
+    full = {(os.path.basename(f.path), f.rule)
+            for f in analysis.run_paths(analysis.collect_files(root))
+            if f.path in set(changed)}
+    assert inc == full == {("svc_dispatch.py", "wait-after-kill"),
+                           ("xfer_dispatch.py", "per-item-transfer")}
+
+
+def test_changed_cli_paths_and_bad_ref():
+    """CLI wiring: ``--changed`` with explicit paths is an error, and
+    an unresolvable ref exits 2 (distinct from the findings exit 1)."""
+    r = _run_cli("--changed", "HEAD",
+                 os.path.join(FIXTURES, "bad_multiprocessing.py"))
+    assert r.returncode == 2
+    r = _run_cli("--changed", "no-such-ref-xyz")
+    assert r.returncode == 2
+    assert "--changed" in r.stderr
