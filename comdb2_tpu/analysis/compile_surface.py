@@ -202,6 +202,7 @@ def _ladders() -> dict:
     lim = ServiceLimits()
     specs = [spec for _, _, _, spec in production_tiers()]
     from ..checker import mxu
+    from ..checker import pallas_seg
     from ..checker.linear_jax import make_pack_plan
 
     # every PackPlan word count reachable inside the MXU table caps —
@@ -244,6 +245,10 @@ def _ladders() -> dict:
         # session slot widths: even-bucketed like the driver, capped
         # by the MXU crossover ceiling (wider P has no engine)
         "stream_P": tuple(range(2, mxu.MAX_P + 1, 2)),
+        # megabatch session-lane rungs (fused advance: N sessions,
+        # one program) and the kernel rung's small-delta chunk rungs
+        "stream_B": tuple(stream_engine.MEGABATCH_LANES),
+        "stream_small_chunks": tuple(pallas_seg.STREAM_CHUNKS),
     }
 
 
@@ -363,16 +368,63 @@ def static_inventory() -> Inventory:
          (stream_F_ax,), (stream_F_ax, stream_P_ax), (stream_F_ax,),
          (), (), ()),
     ]
+    # megabatch session-lane ladder (round 13): N same-shape-class
+    # sessions advance in ONE program — B-tuples of per-lane memo
+    # tables/carries plus lane-major delta tensors
+    stream_B_ax = Axis("session_B", "enum", values=L["stream_B"])
+    # the kernel rung's chunk axis gains the small-delta rungs
+    # (pallas_seg.STREAM_CHUNKS via delta_spec) — stream jit names
+    # only; pallas-stream-scan keeps the tight spec_for ladder
+    stream_chunk_ax = Axis(
+        "stream_chunk", "enum",
+        values=tuple(sorted(set(L["kernel_chunks"]) | {16}
+                            | set(L["stream_small_chunks"]))))
+    stream_mb_templates = []
+    for Bn in L["stream_B"]:
+        stream_mb_templates.append(
+            ((memo, memo),) * Bn
+            + ((stream_B_ax, stream_delta_ax, stream_K),
+               (stream_B_ax, stream_delta_ax, stream_K),
+               (stream_B_ax, stream_delta_ax),
+               (stream_B_ax, stream_delta_ax), (stream_B_ax,))
+            + ((stream_F_ax,), (stream_F_ax, stream_P_ax),
+               (stream_F_ax,), (), (), ()) * Bn)
+    # MXU-rung megabatch: same lane-major deltas (pads floored to the
+    # MXU chunk ladder) + B-tuples of the B=1 chunk-form carry
+    mxu_mb_templates = []
+    for Bn in L["stream_B"]:
+        for W in L["mxu_words"]:
+            mxu_mb_templates.append(
+                ((mxu_S, mxu_T),) * Bn
+                + ((stream_B_ax, mxu_chunk_ax, mxu_K),
+                   (stream_B_ax, mxu_chunk_ax, mxu_K),
+                   (stream_B_ax, mxu_chunk_ax),
+                   (stream_B_ax, mxu_chunk_ax), (stream_B_ax,))
+                + (((mxu_F,),) * W
+                   + ((mxu_F,), (one,), (one,), (one,))) * Bn)
     # the kernel rung's chunk call: one spec chunk + offsets + the
     # (ws, stat, res) carry + packed table — same axes as the
     # pallas-stream-scan ladder, single-chunk form
+    off2 = Axis("off", "enum", values=(2,))
+    res8 = Axis("res_rows", "enum", values=(8,))
     stream_kernel_templates = []
     for W in L["kernel_words"]:
         stream_kernel_templates.append(
-            ((chunk, width), (Axis("off", "enum", values=(2,)),))
+            ((stream_chunk_ax, width), (off2,))
             + ((rows, lane),) * W
-            + ((one, lane), (Axis("res_rows", "enum", values=(8,)),
-                             lane), (table_rows, lane)))
+            + ((one, lane), (res8, lane), (table_rows, lane)))
+    # kernel-rung megabatch: lane-major packed chunks (B, chunk,
+    # 2+2K), per-lane (offset, nt) rows, B-tuples of (ws, stat, res,
+    # table) — one Mosaic build shared across lanes inside one jit
+    stream_kernel_mb_templates = []
+    for Bn in L["stream_B"]:
+        for W in L["kernel_words"]:
+            stream_kernel_mb_templates.append(
+                ((stream_B_ax, stream_chunk_ax, width),
+                 (stream_B_ax, off2))
+                + (((rows, lane),) * W
+                   + ((one, lane), (res8, lane),
+                      (table_rows, lane))) * Bn)
 
     sites = (
         Site(
@@ -437,7 +489,10 @@ def static_inventory() -> Inventory:
         ),
         Site(
             key="stream-delta",
-            jit_names=("stream_delta_chunk", "stream_kernel_delta"),
+            jit_names=("stream_delta_chunk", "stream_kernel_delta",
+                       "stream_delta_megabatch",
+                       "stream_kernel_delta_mb",
+                       "check_device_mxu_megabatch"),
             note="streaming-session delta dispatch (stream/engine): "
                  "the ONE device entry an append reaches. "
                  "`stream_delta_chunk` is the XLA rung — delta "
@@ -449,13 +504,24 @@ def static_inventory() -> Inventory:
                  "`stream_kernel_delta` is the kernel rung's chunk "
                  "call (same Mosaic program family as "
                  "pallas-stream-scan, re-jitted under a declared "
-                 "serving name). The MXU rung rides the mxu-frontier "
-                 "site's chunk form with delta pads floored to its "
-                 "chunk ladder (MXU_DELTA_FLOOR)",
+                 "serving name; delta_spec adds the STREAM_CHUNKS "
+                 "small-delta rungs). The MXU rung rides the "
+                 "mxu-frontier site's chunk form with delta pads "
+                 "floored to its chunk ladder (MXU_DELTA_FLOOR). "
+                 "The `*_megabatch`/`*_mb` forms are the round-13 "
+                 "fused advance: a beat's same-shape-class lanes "
+                 "stack onto the session_B pow2 ladder (pad = "
+                 "duplicate lane 0) and run as ONE program per "
+                 "rung — B-tuples of per-lane memo tables and "
+                 "carries, lane-major delta tensors",
             templates=tuple(stream_templates)
-            + tuple(stream_kernel_templates),
+            + tuple(stream_mb_templates)
+            + tuple(mxu_mb_templates)
+            + tuple(stream_kernel_templates)
+            + tuple(stream_kernel_mb_templates),
             axes_doc=(stream_delta_ax, stream_K, stream_F_ax,
-                      stream_P_ax, memo),
+                      stream_P_ax, stream_B_ax, stream_chunk_ax,
+                      memo),
         ),
         Site(
             key="xla-batch-vmap",
@@ -581,6 +647,48 @@ def _witness_specs():
                               st((16, 2)), st((16,)), st((16,)),
                               st(()), carry)
 
+    def stream_megabatch_witness():
+        from ..stream import engine as SE
+
+        fn = functools.partial(SE.stream_delta_megabatch, F=256,
+                               Fs=32, P=2, n_states=16,
+                               n_transitions=16)
+        carry = (st((256,)), st((256, 2)), st((256,), np.bool_),
+                 st(()), st(()), st(()))
+        return jax.eval_shape(fn, (st((16, 16)),) * 2,
+                              st((2, 16, 2)), st((2, 16, 2)),
+                              st((2, 16)), st((2, 16)), st((2,)),
+                              (carry, carry))
+
+    def mxu_megabatch_witness():
+        from ..checker import mxu as MXU
+
+        lane = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            MXU.init_carry(1, 1024, 16, 32, 32))
+        fn = functools.partial(MXU._megabatch_jit, F=1024, P=16,
+                               n_states=32, n_transitions=32)
+        return jax.eval_shape(fn, (st((32, 32)),) * 2,
+                              st((2, 64, 2)), st((2, 64, 2)),
+                              st((2, 64)), st((2, 64)), st((2,)),
+                              (lane, lane))
+
+    def stream_kernel_mb_witness():
+        from ..checker import pallas_seg as PS
+        from ..stream import engine as SE
+
+        spec = PS.spec_for(8, 32, 4, 2)
+        assert spec is not None
+        dspec = PS.delta_spec(spec, 16)
+        fn = SE.stream_kernel_megabatch(dspec, 2)
+        W = spec.n_words
+        lane = (tuple(st((spec.rows, 128)) for _ in range(W)),
+                st((1, 128)), st((8, 128)),
+                st((spec.table_rows_pad, 128)))
+        return jax.eval_shape(
+            fn, st((2, dspec.chunk, 2 + 2 * spec.K)), st((2, 2)),
+            (lane, lane))
+
     def _witness_mesh():
         # a 1-device mesh: available on every platform, and the D=1
         # rung keeps the artifact deterministic across environments
@@ -640,6 +748,17 @@ def _witness_specs():
         ("stream-delta",
          "stream_delta_chunk at (16,16) delta=16 K=2 F=256 P=2",
          stream_delta_witness),
+        ("stream-delta",
+         "stream_delta_megabatch: same rung fused at session_B=2",
+         stream_megabatch_witness),
+        ("stream-delta",
+         "check_device_mxu_megabatch at (32,32) delta=64 P=16 "
+         "F=1024, session_B=2",
+         mxu_megabatch_witness),
+        ("stream-delta",
+         "stream_kernel_delta_mb: spec_for(8,32,P=4,K=2) at "
+         "delta_spec chunk=64, session_B=2",
+         stream_kernel_mb_witness),
         ("txn-closure", "closure bucket N=16", closure_witness),
         ("txn-closure",
          "closure_diag_kernel_sharded: B=2 N=16, D=1 mesh rung",
@@ -777,6 +896,14 @@ def render_programs() -> str:
         f"| stream P | even {L['stream_P'][0]}..{L['stream_P'][-1]} |"
         " session slot width (renamed concurrency, even-bucketed; "
         "in-place expand_seg_carry_slots widening) |",
+        f"| stream session B | {list(L['stream_B'])} | "
+        "`stream.engine.MEGABATCH_LANES` (fused-advance lane rungs; "
+        "short groups pad by duplicating lane 0, single lanes go "
+        "solo) |",
+        f"| stream kernel small chunks | "
+        f"{list(L['stream_small_chunks'])} | "
+        "`pallas_seg.STREAM_CHUNKS` (`delta_spec` small-delta rungs "
+        "under the stream jit names; base chunks stay spec_for's) |",
         "",
         "## Dispatch sites",
         "",
@@ -854,10 +981,16 @@ SHAPE_SINKS: Dict[str, dict] = {
     "stream_dispatch_sharded": {"kwargs": ("n_states",
                                            "n_transitions")},
     "check_sharded": {"kwargs": ("n_states", "n_transitions")},
-    # the streaming-session delta entrypoint: raw memo counts here
+    # the streaming-session delta entrypoints: raw memo counts here
     # would compile one program per live history's alphabet — every
-    # caller must route through stream.engine.pad_sizes
+    # caller must route through stream.engine.pad_sizes. The fused
+    # megabatch forms are the same sink (one unbucketed lane would
+    # seed a program for the WHOLE group's shape class)
     "stream_delta_chunk": {"kwargs": ("n_states", "n_transitions")},
+    "stream_delta_megabatch": {"kwargs": ("n_states",
+                                          "n_transitions")},
+    "check_device_mxu_megabatch": {"kwargs": ("n_states",
+                                              "n_transitions")},
 }
 
 #: callables that PRODUCE bucketed values

@@ -485,6 +485,42 @@ def check_device_mxu_chunk(succ, inv_proc, inv_tr, ok_proc, depth,
                  n_transitions)
 
 
+@functools.partial(jax.jit, static_argnames=("F", "P", "n_states",
+                                             "n_transitions"))
+def check_device_mxu_megabatch(succs, inv_proc, inv_tr, ok_proc,
+                               depth, seg_offset, carries, *, F: int,
+                               P: int, n_states: int,
+                               n_transitions: int):
+    """B session-lanes of the chunk form fused into ONE program (the
+    stream megabatch, docs/streaming.md "Megabatched advance"):
+    ``succs``/``carries`` are B-tuples (each session owns its memo
+    table and resident B=1 carry), delta tensors are lane-major
+    ``(B, S, K)`` / ``(B, S)``, ``seg_offset`` is ``(B,)``. The lane
+    body IS the chunk scan — vmap of its deterministic integer ops is
+    elementwise-identical to B solo dispatches (padding lanes and
+    dead ``ok_proc=-1`` segments select the old carry), so the fused
+    carries are bit-equal to the per-session path. Returns a B-tuple
+    of updated carries."""
+    plan = _plan_for(n_states, n_transitions, P)
+    succ_b = jnp.stack(succs)
+    carry_b = jax.tree.map(lambda *xs: jnp.stack(xs), *carries)
+
+    def lane(succ_l, ip, it, okp, dp, off, carry):
+        sv, so = _succ_planes(succ_l)
+        S, K = ip.shape
+        segs = (ip.reshape(S, 1, K), it.reshape(S, 1, K),
+                okp.reshape(S, 1),
+                off + jnp.arange(S, dtype=jnp.int32), dp)
+        step = _make_step(plan, sv, so, 1, F, K)
+        carry2, _ = lax.scan(step, carry, segs)
+        return carry2
+
+    out = jax.vmap(lane)(succ_b, inv_proc, inv_tr, ok_proc, depth,
+                         seg_offset, carry_b)
+    return tuple(jax.tree.map(lambda x: x[i], out)
+                 for i in range(len(carries)))
+
+
 # --- counted public entries -------------------------------------------------
 #
 # The jitted cores above keep the public names — the compile log (and
@@ -496,6 +532,7 @@ def check_device_mxu_chunk(succ, inv_proc, inv_tr, ok_proc, depth,
 _batch_jit = check_device_mxu_batch
 _single_jit = check_device_mxu
 _chunk_jit = check_device_mxu_chunk
+_megabatch_jit = check_device_mxu_megabatch
 
 
 def check_device_mxu_batch(succ, inv_proc, inv_tr, ok_proc, depth, *,
@@ -531,7 +568,22 @@ def check_device_mxu_chunk(succ, inv_proc, inv_tr, ok_proc, depth,
                       n_transitions=n_transitions)
 
 
+def check_device_mxu_megabatch(succs, inv_proc, inv_tr, ok_proc,
+                               depth, seg_offset, carries, *, F: int,
+                               P: int, n_states: int,
+                               n_transitions: int):
+    """Counted dispatch of the fused session-lane engine (core
+    above) — ONE program regardless of lane count."""
+    global DISPATCHES
+    DISPATCHES += 1
+    return _megabatch_jit(succs, inv_proc, inv_tr, ok_proc, depth,
+                          seg_offset, carries, F=F, P=P,
+                          n_states=n_states,
+                          n_transitions=n_transitions)
+
+
 __all__ = ["CAPACITIES", "CHUNK", "DISPATCHES", "MAX_P", "MIN_P",
            "S_CAP", "T_CAP", "check_device_mxu",
            "check_device_mxu_batch", "check_device_mxu_chunk",
-           "enabled", "expand_carry", "fits", "init_carry", "serves"]
+           "check_device_mxu_megabatch", "enabled", "expand_carry",
+           "fits", "init_carry", "serves"]

@@ -228,6 +228,26 @@ def spec_for(n_states: int, n_transitions: int, P: int,
                          rows, n_words)
 
 
+#: small-delta chunk rungs for the STREAMING kernel rung only (the
+#: batch/driver path always scans full chunks): a 16-op append on a
+#: spec.chunk=1024 program pays the whole 1024-step grid — these
+#: spec._replace(chunk=...) variants keep the carry geometry (rows,
+#: n_words are chunk-independent) while shrinking the grid, at the
+#: price of at most len(STREAM_CHUNKS) extra Mosaic builds per base
+#: spec. Closed ladder: PROGRAMS.md stream-delta declares them.
+STREAM_CHUNKS = (64, 256)
+
+
+def delta_spec(spec: SegKernelSpec, n_segments: int) -> SegKernelSpec:
+    """The smallest declared chunk rung serving an ``n_segments``
+    delta (the base spec when none is smaller — interpret mode's
+    chunk=16 already undercuts the ladder and passes through)."""
+    for c in STREAM_CHUNKS:
+        if c >= n_segments and c < spec.chunk:
+            return spec._replace(chunk=c)
+    return spec
+
+
 def pack_table(succ: np.ndarray, rows: int = ROWS) -> np.ndarray:
     """Flatten the successor table into a (rows, 128) int32 block
     (row-major, padded with -1)."""

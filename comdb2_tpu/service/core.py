@@ -286,6 +286,9 @@ class VerifierCore:
             # (open-with-checkpoint), drain entries
             "stream_checkpoints": 0, "stream_migrations": 0,
             "drains": 0,
+            # megabatched advances (round 13): fused programs that
+            # carried >= 2 session lanes in one dispatch
+            "stream_megabatches": 0,
         }
         self._g_sessions = self.metrics.gauge(
             "stream_sessions_active",
@@ -293,6 +296,19 @@ class VerifierCore:
         self._g_carry_bytes = self.metrics.gauge(
             "stream_carry_resident_bytes",
             help="device bytes held by resident session carries")
+        # megabatch amortization plane (docs/streaming.md
+        # "Megabatched advance"): how many session lanes each
+        # launched stream program advanced (solo dispatches observe
+        # 1), and the latest beat's fused lane count
+        self._h_mb_lanes = self.metrics.histogram(
+            "sessions_per_dispatch",
+            help="session lanes advanced per launched stream "
+                 "program",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+        self._g_mb_lanes = self.metrics.gauge(
+            "stream_megabatch_lanes",
+            help="session lanes riding fused megabatch programs in "
+                 "the most recent stream batch")
         # elastic-fleet plane (docs/service.md "Elastic fleet"):
         # membership + migration visibility in every scrape
         self._g_epoch = self.metrics.gauge(
@@ -920,13 +936,14 @@ class VerifierCore:
     def _dispatch_stream_begin(self, bucket: StreamBucket,
                                items: List[PendingRequest]):
         """Stage one shape-class batch of session appends: each
-        session ingests its delta and dispatches ONLY the new
-        segments against its resident carry (async), so the staging
-        pass overlaps all the deltas' device runs; ``finish`` reads
-        the verdicts back oldest-first. Same ring contract as
-        :meth:`_dispatch_begin`; same-shape sessions share the
-        ``stream-delta`` programs so the batch amortizes compiles
-        even though each carry is its own dispatch."""
+        session ingests its delta and parks its new segments in the
+        beat's forming MEGABATCH (``stream.engine.MegaBatch``) — one
+        flush advances every lane in ONE fused device dispatch per
+        shape class (docs/streaming.md "Megabatched advance");
+        ``finish`` reads the verdicts back oldest-first. Same ring
+        contract as :meth:`_dispatch_begin`. Deltas the fused entries
+        can't lane (oversized, mid-batch growth replays) dispatch
+        solo inside the same beat and count as 1-lane programs."""
         from ..stream import engine as _SE
 
         t0 = obs.monotonic()
@@ -935,15 +952,21 @@ class VerifierCore:
             p.stages["queue_wait_ms"] = (t0 - p.t_in) * 1e3
         fins = []
         d0 = _SE.DISPATCHES
+        coll = _SE.MegaBatch()
         with obs.span("stage", kind="stream", bucket=bucket.key,
                       b=len(items), rids=rids):
             for p in items:
                 sid, s, ops = p.packed
                 try:
-                    fins.append(s.append_stage(ops))
+                    fins.append(s.append_stage(ops, collector=coll))
                 except Exception as e:          # noqa: BLE001
                     cause = f"engine: {type(e).__name__}: {e}"
                     fins.append(("err", cause))
+            coll.flush()
+        for c in coll.lane_counts:
+            self._h_mb_lanes.observe(float(c))
+        self._g_mb_lanes.set(float(coll.fused_lanes))
+        self.m["stream_megabatches"] += coll.fused_launches
         t_staged = obs.monotonic()
         pack_ms = (t_staged - t0) * 1e3
         for p in items:

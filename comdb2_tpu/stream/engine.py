@@ -44,6 +44,7 @@ from jax import lax
 from ..checker import linear_jax as LJ
 from ..checker import mxu as MXU
 from ..checker import pallas_seg as PSEG
+from ..obs import trace as _obs
 from ..utils import next_pow2 as _next_pow2
 
 #: padded segments per delta dispatch — the pow2 ladder every append
@@ -62,8 +63,20 @@ STREAM_CAPACITIES = (256, 1024, 8192, 65536)
 STREAM_FS = 32
 
 #: stream delta dispatches this process (all rungs) — the O(delta)
-#: counter tests and benches assert on
+#: counter tests and benches assert on. Counts launched PROGRAMS, not
+#: session lanes: a megabatched advance of 8 sessions is ONE dispatch
 DISPATCHES = 0
+
+#: fused megabatch launches this process (each also counts once in
+#: DISPATCHES) — the amortization counter
+MEGABATCHES = 0
+
+#: session-lane pow2 ladder of the fused megabatch entries (PROGRAMS.md
+#: ``stream-delta`` session_B axis): a beat's same-shape-class lanes
+#: pad up to the next rung by duplicating lane 0 (outputs discarded);
+#: more than the top rung splits into top-rung launches; a single lane
+#: falls back to the solo entries (no padded-lane waste)
+MEGABATCH_LANES = (2, 4, 8, 16)
 
 #: ladder ceilings (PROGRAMS.md stream-delta axes): a session whose
 #: renamed concurrency or per-segment invoke burst outgrows them has
@@ -307,15 +320,18 @@ class KernelCarry:
     def begin_delta(self) -> None:
         self._pre = (self.ws, self.stat)
 
-    def dispatch(self, table, chunks, seg_offset) -> None:
+    def dispatch(self, table, chunks, seg_offset, spec=None) -> None:
         """``chunks``: (n_chunks, chunk, 2+2K) from ``pack_segments``;
         the offsets bias fail indices into session-global segment
-        coordinates."""
+        coordinates. ``spec`` selects a small-delta chunk rung
+        (``pallas_seg.delta_spec``) — same carry geometry (rows and
+        n_words are chunk-independent), smaller grid."""
         global DISPATCHES
-        call = stream_kernel_chunk(self.spec)
+        sp = spec or self.spec
+        call = stream_kernel_chunk(sp)
         for c in range(chunks.shape[0]):
             DISPATCHES += 1
-            off = np.array([seg_offset + c * self.spec.chunk,
+            off = np.array([seg_offset + c * sp.chunk,
                             self.nt], np.int32)
             self.ws, self.stat, self._res = call(
                 jnp.asarray(chunks[c]), jnp.asarray(off), self.ws,
@@ -372,6 +388,244 @@ def stream_kernel_chunk(spec):
     return jax.jit(stream_kernel_delta)
 
 
+@functools.partial(jax.jit, static_argnames=("F", "Fs", "P",
+                                             "n_states",
+                                             "n_transitions"))
+def stream_delta_megabatch(succs, inv_proc, inv_tr, ok_proc, depth,
+                           seg_offset, carries, *, F: int, Fs: int,
+                           P: int, n_states: int, n_transitions: int):
+    """B session-lanes of :func:`stream_delta_chunk` fused into ONE
+    program (docs/streaming.md "Megabatched advance"): ``succs`` and
+    ``carries`` are B-tuples (every session owns its memo table and
+    resident carry), delta tensors are lane-major ``(B, S, K)`` /
+    ``(B, S)``, ``seg_offset`` is ``(B,)``. The lane body IS the solo
+    chunk scan, so vmap of its deterministic integer ops — padding
+    lanes included — returns carries bit-equal to B solo dispatches
+    (dead ``ok_proc=-1`` segments and latched lanes select the old
+    carry inside ``_make_seg_step``). Returns a B-tuple of carries."""
+    bits = LJ._bits_for(n_states, n_transitions, P)
+    S, K = inv_proc.shape[1], inv_proc.shape[2]
+    succ_b = jnp.stack(succs)
+    carry_b = jax.tree.map(lambda *xs: jnp.stack(xs), *carries)
+
+    def lane(succ_l, ip, it, okp, dp, off, carry):
+        segs = (ip, it, okp, off + jnp.arange(S, dtype=jnp.int32), dp)
+        step = LJ._make_seg_step(succ_l, F, P, K, bits,
+                                 Fs=LJ._seg2_tier(Fs, F))
+        carry2, _ = lax.scan(step, carry, segs)
+        return carry2
+
+    out = jax.vmap(lane)(succ_b, inv_proc, inv_tr, ok_proc, depth,
+                         seg_offset, carry_b)
+    return tuple(jax.tree.map(lambda x: x[i], out)
+                 for i in range(len(carries)))
+
+
+@functools.lru_cache(maxsize=16)
+def stream_kernel_megabatch(spec, B: int):
+    """B kernel-rung lanes fused into ONE jitted program: the Mosaic
+    chunk program (shared via the ``_chunk_call`` cache — one build)
+    is invoked once per lane INSIDE one jit, so the batch costs one
+    dispatch round-trip. ``lanes`` is a B-tuple of per-lane
+    ``(ws, stat, res, table)``; ``segs`` is ``(B, chunk, 2+2K)`` and
+    ``offs`` ``(B, 2)`` (global segment offset + the lane's runtime
+    table stride nt)."""
+    call = PSEG._chunk_call(spec)
+
+    def stream_kernel_delta_mb(segs, offs, lanes):
+        out = []
+        for i in range(B):
+            ws, stat, res, table = lanes[i]
+            out.append(call(segs[i], offs[i], ws, stat, res, table))
+        return tuple(out)
+
+    return jax.jit(stream_kernel_delta_mb)
+
+
+class _Lane:
+    """One session's pending delta inside a forming megabatch. The
+    pack/pad closures defer array building to flush time, when the
+    GROUP's pad rung (max over lanes) is known."""
+
+    __slots__ = ("sess", "eng", "n", "k_pad", "pad_fn", "succ",
+                 "seg_offset", "pack_fn", "table")
+
+    def __init__(self, sess, eng, n, seg_offset, k_pad=0, pad_fn=None,
+                 succ=None, pack_fn=None, table=None):
+        self.sess = sess
+        self.eng = eng
+        self.n = n
+        self.seg_offset = seg_offset
+        self.k_pad = k_pad
+        self.pad_fn = pad_fn
+        self.succ = succ
+        self.pack_fn = pack_fn
+        self.table = table
+
+
+class MegaBatch:
+    """Per-beat collector fusing same-shape-class session deltas into
+    one device dispatch (the tentpole of docs/streaming.md
+    "Megabatched advance"). Sessions JOIN during staging
+    (:meth:`~comdb2_tpu.stream.session.StreamSession.append_stage`
+    with ``collector=``) and the service flushes once per beat;
+    every staged finalize also flushes first, so a second append to
+    one session (which forces the first's finalize) can never read a
+    carry whose delta is still parked here. ``flush`` DRAINS the
+    queue and is repeat-callable — later joins start a new round.
+
+    Group keys pin everything jit-static: ``(rung, F, P2, k_pad, ns,
+    nt)`` for the XLA/MXU rungs, ``("kernel", spec)`` for the fused
+    kernel (nt rides per-lane in the runtime offs row). Lane counts
+    pad onto the ``MEGABATCH_LANES`` pow2 ladder by duplicating lane
+    0 (outputs discarded); a lone lane falls back to the solo entry.
+    A group launch failure latches every joined session UNKNOWN —
+    their carries never saw the delta, so letting their finalizes
+    read the stale (pre-delta) carry would report a verdict for work
+    that never ran."""
+
+    def __init__(self):
+        self._groups: dict = {}
+        self.launches = 0        # device programs launched (all forms)
+        self.fused_launches = 0  # megabatched programs (>= 2 lanes)
+        self.fused_lanes = 0     # real lanes riding fused programs
+        self.masked_lanes = 0    # duplicated pad lanes (discarded)
+        self.solo_lanes = 0      # single-lane fallbacks
+        self.lane_counts: list = []   # real lanes per launched program
+
+    def add_delta(self, rung: str, sess, eng, n: int, k_pad: int,
+                  pad_fn, succ, seg_offset: int) -> None:
+        """Queue one XLA/MXU-rung delta; ``pad_fn(s_pad)`` builds the
+        (ip, it, okp, dp) host arrays at the group's pad rung."""
+        key = (rung, eng.F, eng.P2, k_pad, eng.ns, eng.nt)
+        self._groups.setdefault(key, []).append(
+            _Lane(sess, eng, n, seg_offset, k_pad=k_pad,
+                  pad_fn=pad_fn, succ=succ))
+
+    def add_kernel(self, sess, eng, n: int, pack_fn, table,
+                   seg_offset: int) -> None:
+        """Queue one kernel-rung delta; ``pack_fn(dspec)`` packs the
+        single scalar chunk at the group's delta-chunk rung."""
+        key = ("kernel", eng.spec)
+        self._groups.setdefault(key, []).append(
+            _Lane(sess, eng, n, seg_offset, pack_fn=pack_fn,
+                  table=table))
+
+    def flush(self) -> None:
+        while self._groups:
+            groups, self._groups = self._groups, {}
+            for key, lanes in groups.items():
+                try:
+                    self._launch_group(key, lanes)
+                except Exception as e:      # noqa: BLE001 — engine
+                    cause = f"engine: {type(e).__name__}: {e}"
+                    for ln in lanes:
+                        ln.sess._latch_unknown(cause)
+
+    # -- launch forms --------------------------------------------------
+
+    def _launch_group(self, key, lanes) -> None:
+        top = MEGABATCH_LANES[-1]
+        for i in range(0, len(lanes), top):
+            chunk = lanes[i:i + top]
+            if len(chunk) == 1:
+                self._launch_solo(key, chunk[0])
+            elif key[0] == "kernel":
+                self._launch_kernel(key[1], chunk)
+            else:
+                self._launch_delta(key, chunk)
+
+    def _stat(self, rung: str, b_real: int, b_pad: int, t0: float
+              ) -> None:
+        self.launches += 1
+        self.lane_counts.append(b_real)
+        if b_real == 1:
+            self.solo_lanes += 1
+        else:
+            self.fused_launches += 1
+            self.fused_lanes += b_real
+            self.masked_lanes += b_pad - b_real
+        _obs.record("stream.megabatch", t0, _obs.monotonic(),
+                    rung=rung, lanes=b_real, masked=b_pad - b_real)
+
+    def _launch_solo(self, key, ln) -> None:
+        t0 = _obs.monotonic()
+        if key[0] == "kernel":
+            dspec = PSEG.delta_spec(key[1], ln.n)
+            ln.eng.dispatch(ln.table, ln.pack_fn(dspec),
+                            ln.seg_offset, spec=dspec)
+        else:
+            floor = MXU_DELTA_FLOOR if key[0] == "mxu" else 0
+            s_pad = bucket_delta(ln.n, floor)
+            ip, it, okp, dp = ln.pad_fn(s_pad)
+            ln.eng.dispatch(ln.succ, ip, it, okp, dp, ln.seg_offset)
+        ln.sess.dispatches += 1
+        self._stat(key[0], 1, 1, t0)
+
+    def _launch_kernel(self, spec, chunk) -> None:
+        global DISPATCHES, MEGABATCHES
+        t0 = _obs.monotonic()
+        b_real = len(chunk)
+        b_pad = next(b for b in MEGABATCH_LANES if b >= b_real)
+        dspec = PSEG.delta_spec(spec, max(ln.n for ln in chunk))
+        packs = []
+        for ln in chunk:
+            p = ln.pack_fn(dspec)
+            if p.shape[0] != 1:         # join gate guarantees this
+                raise ValueError("megabatch kernel lane spans chunks")
+            packs.append(p[0])
+        segs = np.stack(packs + [packs[0]] * (b_pad - b_real))
+        offs = np.array(
+            [[ln.seg_offset, ln.eng.nt] for ln in chunk]
+            + [[chunk[0].seg_offset, chunk[0].eng.nt]]
+            * (b_pad - b_real), np.int32)
+        lanes_in = tuple((ln.eng.ws, ln.eng.stat, ln.eng._res,
+                          ln.table) for ln in chunk)
+        lanes_in += (lanes_in[0],) * (b_pad - b_real)
+        DISPATCHES += 1
+        MEGABATCHES += 1
+        outs = stream_kernel_megabatch(dspec, b_pad)(
+            jnp.asarray(segs), jnp.asarray(offs), lanes_in)
+        for ln, out in zip(chunk, outs):
+            ln.eng.ws, ln.eng.stat, ln.eng._res = out
+            ln.sess.dispatches += 1
+        self._stat("kernel", b_real, b_pad, t0)
+
+    def _launch_delta(self, key, chunk) -> None:
+        global DISPATCHES, MEGABATCHES
+        t0 = _obs.monotonic()
+        rung, F, P2, _k_pad, ns, nt = key
+        b_real = len(chunk)
+        b_pad = next(b for b in MEGABATCH_LANES if b >= b_real)
+        floor = MXU_DELTA_FLOOR if rung == "mxu" else 0
+        s_pad = max(bucket_delta(ln.n, floor) for ln in chunk)
+        arrs = [ln.pad_fn(s_pad) for ln in chunk]
+        arrs += [arrs[0]] * (b_pad - b_real)
+        ip, it, okp, dp = (np.stack([a[j] for a in arrs])
+                           for j in range(4))
+        offs = np.array([ln.seg_offset for ln in chunk]
+                        + [chunk[0].seg_offset] * (b_pad - b_real),
+                        np.int32)
+        succs = tuple(ln.succ for ln in chunk)
+        succs += (succs[0],) * (b_pad - b_real)
+        carries = tuple(ln.eng.carry for ln in chunk)
+        carries += (carries[0],) * (b_pad - b_real)
+        DISPATCHES += 1
+        MEGABATCHES += 1
+        if rung == "mxu":
+            outs = MXU.check_device_mxu_megabatch(
+                succs, ip, it, okp, dp, offs, carries, F=F, P=P2,
+                n_states=ns, n_transitions=nt)
+        else:
+            outs = stream_delta_megabatch(
+                succs, ip, it, okp, dp, offs, carries, F=F,
+                Fs=STREAM_FS, P=P2, n_states=ns, n_transitions=nt)
+        for ln, carry in zip(chunk, outs):
+            ln.eng.carry = carry
+            ln.sess.dispatches += 1
+        self._stat(rung, b_real, b_pad, t0)
+
+
 def kernel_spec(n_states: int, n_transitions: int, P2: int,
                 K: int) -> Optional[object]:
     """The session's kernel spec, or None when the shape can't run
@@ -402,8 +656,10 @@ def pad_sizes(n_states: int, n_transitions: int) -> Tuple[int, int]:
     return _next_pow2(n_states), _next_pow2(n_transitions)
 
 
-__all__ = ["DELTA_PADS", "DISPATCHES", "KernelCarry", "MXU_DELTA_FLOOR",
+__all__ = ["DELTA_PADS", "DISPATCHES", "KernelCarry", "MEGABATCHES",
+           "MEGABATCH_LANES", "MXU_DELTA_FLOOR", "MegaBatch",
            "MxuCarry", "STREAM_CAPACITIES", "STREAM_MAX_K",
            "STREAM_MAX_P", "XlaCarry", "bucket_delta", "kernel_spec",
            "pad_sizes", "pick_rung", "stream_delta_chunk",
-           "stream_kernel_chunk"]
+           "stream_delta_megabatch", "stream_kernel_chunk",
+           "stream_kernel_megabatch"]

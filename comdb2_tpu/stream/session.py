@@ -87,12 +87,17 @@ class StreamSession:
         fin = self.append_stage(ops)
         return fin()
 
-    def append_stage(self, ops):
+    def append_stage(self, ops, collector=None):
         """Stage one append (ingest + async dispatch) and return a
         zero-arg finalize producing the verdict map — the service tick
         overlaps other sessions' host work with this one's device run.
         Appends to one session serialize: staging while an earlier
-        append is unfinalized finalizes it first."""
+        append is unfinalized finalizes it first.
+
+        ``collector`` (an :class:`~comdb2_tpu.stream.engine.MegaBatch`)
+        parks this delta in the beat's forming megabatch instead of
+        dispatching solo; the finalize flushes the collector before
+        reading the carry, so callers may finalize in any order."""
         if self._inflight is not None:
             self._inflight()
         if self.closed:
@@ -113,7 +118,7 @@ class StreamSession:
         except MalformedDelta as e:
             self._latch_unknown(f"malformed: {e}")
             return lambda: self._verdict_map()
-        return self._stage_settled(lo, hi)
+        return self._stage_settled(lo, hi, collector)
 
     def finalize_input(self) -> dict:
         """End of stream: settle the tail (open invokes keep their
@@ -276,7 +281,7 @@ class StreamSession:
 
     # -- staging -------------------------------------------------------
 
-    def _stage_settled(self, lo: int, hi: int):
+    def _stage_settled(self, lo: int, hi: int, collector=None):
         try:
             self._extend_memo()
             with _obs.span("stream.segment", lo=lo, hi=hi):
@@ -305,7 +310,7 @@ class StreamSession:
             self._maintain_shapes()
             with _obs.span("stream.dispatch", s_lo=s_lo, s_hi=s_hi,
                            engine=self._rung):
-                self._dispatch_range(s_lo, s_hi)
+                self._dispatch_range(s_lo, s_hi, collector)
         except Exception as e:          # noqa: BLE001 — engine blowup
             self._latch_unknown(f"engine: {type(e).__name__}: {e}")
             return lambda: self._verdict_map()
@@ -322,7 +327,16 @@ class StreamSession:
                 return done["out"]
             self._inflight = None
             try:
-                self._finalize_range(s_lo, s_hi)
+                if collector is not None:
+                    # the delta may still be parked in the beat's
+                    # forming megabatch (a second append to this
+                    # session forces THIS finalize before the
+                    # service's own flush) — drain it first, and
+                    # skip the carry read when the flush latched us
+                    # (a failed group launch never ran this delta)
+                    collector.flush()
+                if not self._latched():
+                    self._finalize_range(s_lo, s_hi)
             except Exception as e:      # noqa: BLE001
                 self._latch_unknown(
                     f"engine: {type(e).__name__}: {e}")
@@ -447,25 +461,72 @@ class StreamSession:
             self._table_key = key
         return self._table_dev
 
-    def _dispatch_range(self, s_lo: int, s_hi: int) -> None:
+    def _dispatch_range(self, s_lo: int, s_hi: int,
+                        collector=None) -> None:
         """Dispatch segments [s_lo, s_hi) against the resident carry,
         bucketed on the delta_pad ladder (one pre-delta snapshot for
-        the whole range — escalation re-runs the range)."""
+        the whole range — escalation re-runs the range). With a
+        ``collector`` the delta joins the beat's forming megabatch
+        instead (flushed before any joined finalize reads a carry);
+        deltas too large for one fused lane dispatch solo."""
         self._eng.begin_delta()
+        if collector is not None \
+                and self._megabatch_join(collector, s_lo, s_hi):
+            return
         self._dispatch_chunks(s_lo, s_hi)
+
+    def _megabatch_join(self, collector, s_lo: int,
+                        s_hi: int) -> bool:
+        """Park [s_lo, s_hi) as one lane of the beat's megabatch.
+        The pack/pad closures run at FLUSH time with the group's pad
+        rung — safe because appends to one session serialize through
+        the inflight finalize, which flushes the collector before the
+        segmenter can advance past this range."""
+        n = s_hi - s_lo
+        if self._rung == "kernel":
+            from ..checker import linear_jax as LJ
+            from ..checker import pallas_seg as PSEG
+
+            if n > self._eng.spec.chunk:
+                return False            # multi-chunk: solo path
+
+            def pack(dspec):
+                ip, it, okp, dp = self.seg.padded(s_lo, s_hi, n,
+                                                  dspec.K)
+                segs = LJ.SegmentStream(
+                    ip, it, okp, self.seg.seg_row.a[s_lo:s_hi], dp)
+                return PSEG.pack_segments(segs, dspec)
+
+            collector.add_kernel(self, self._eng, n, pack,
+                                 self._kernel_table(), s_lo)
+            return True
+        if n > ENG.DELTA_PADS[-1]:
+            return False                # splits across rungs: solo
+        k_pad = self._k_bucket()
+
+        def pad(s_pad):
+            return self.seg.padded(s_lo, s_hi, s_pad, k_pad)
+
+        collector.add_delta(self._rung, self, self._eng, n, k_pad,
+                            pad, self._succ_device(), s_lo)
+        return True
 
     def _dispatch_chunks(self, s_lo: int, s_hi: int) -> None:
         if self._rung == "kernel":
             from ..checker import linear_jax as LJ
             from ..checker import pallas_seg as PSEG
 
-            spec = self._eng.spec
+            # small deltas ride the delta-chunk rungs: same carry
+            # geometry, a grid sized to the append instead of the
+            # full spec.chunk scan
+            spec = PSEG.delta_spec(self._eng.spec, s_hi - s_lo)
             ip, it, okp, dp = self.seg.padded(
                 s_lo, s_hi, s_hi - s_lo, spec.K)
             segs = LJ.SegmentStream(ip, it, okp,
                                     self.seg.seg_row.a[s_lo:s_hi], dp)
             chunks = PSEG.pack_segments(segs, spec)
-            self._eng.dispatch(self._kernel_table(), chunks, s_lo)
+            self._eng.dispatch(self._kernel_table(), chunks, s_lo,
+                               spec=spec)
             self.dispatches += chunks.shape[0]
             return
         succ = self._succ_device()

@@ -107,6 +107,73 @@ def main() -> int:
         n_disp = ENG.DISPATCHES - d0
         assert out["valid"] is True, out
 
+        # --- megabatch phase (round 13): N sessions, ONE program per
+        # beat. Identical per-session streams keep every lane in one
+        # shape class, so each beat's appends fuse into a single
+        # launched program — dispatches/beat ~= 1 is the tentpole
+        # claim, counter-asserted below. A solo twin fed the same
+        # beats is the per-session baseline the tunnel model divides.
+        n_lanes = 4
+        mb_beats = 4 if args.quick else 8
+        mb_delta = args.delta // 2
+        mh = register_history(random.Random(17), n_procs=3,
+                              n_events=mb_beats * mb_delta, values=3,
+                              p_info=0.0, max_pending=2)
+        # warm the fused program ladder on throwaway lanes through
+        # the SAME beat trajectory as the timed run: each memo pow2
+        # bucket crossing is a distinct fused program, and the timed
+        # beats must measure dispatch, not first-time compiles (the
+        # solo programs were warmed the same way by the phase above)
+        warm_mb = [StreamSession("cas-register")
+                   for _ in range(n_lanes)]
+        warm_solo = StreamSession("cas-register")
+        for i in range(0, len(mh), mb_delta):
+            coll = ENG.MegaBatch()
+            fins = [w.append_stage(mh[i:i + mb_delta],
+                                   collector=coll)
+                    for w in warm_mb]
+            coll.flush()
+            [f() for f in fins]
+            warm_solo.append(mh[i:i + mb_delta])
+        for w in warm_mb:
+            w.close()
+        warm_solo.close()
+
+        lanes = [StreamSession("cas-register")
+                 for _ in range(n_lanes)]
+        solo_tw = StreamSession("cas-register")
+        per_beat_disp = []
+        beat_ms = []
+        solo_ms = []
+        mb0 = ENG.MEGABATCHES
+        for i in range(0, len(mh), mb_delta):
+            beat = mh[i:i + mb_delta]
+            db = ENG.DISPATCHES
+            coll = ENG.MegaBatch()
+            t0 = time.perf_counter()
+            fins = [ln.append_stage(beat, collector=coll)
+                    for ln in lanes]
+            coll.flush()
+            mb_outs = [f() for f in fins]
+            beat_ms.append((time.perf_counter() - t0) * 1e3)
+            per_beat_disp.append(ENG.DISPATCHES - db)
+            t0 = time.perf_counter()
+            solo_out = solo_tw.append(beat)
+            solo_ms.append((time.perf_counter() - t0) * 1e3)
+        n_mb = ENG.MEGABATCHES - mb0
+        # one launched program advances all N lanes, every beat (a 0
+        # is a watermark-held beat whose rows ride the next one)
+        assert max(per_beat_disp) <= 1, per_beat_disp
+        assert sum(per_beat_disp) >= len(per_beat_disp) - 2, \
+            per_beat_disp
+        assert n_mb == sum(per_beat_disp), (n_mb, per_beat_disp)
+        # fused lanes report the SAME verdict as the solo twin
+        for o in mb_outs:
+            assert o["valid"] == solo_out["valid"], (o, solo_out)
+        for ln in lanes:
+            ln.close()
+        solo_tw.close()
+
     n = len(append_ms)
     head = sum(append_ms[:4]) / 4
     tail = sum(append_ms[-4:]) / 4
@@ -145,6 +212,37 @@ def main() -> int:
         "session": {"replays": out["replays"],
                     "frontier_capacity": out.get("frontier_capacity"),
                     "segments": out["segments"]},
+        "megabatch": {
+            "sessions": n_lanes,
+            "beats": len(per_beat_disp),
+            "delta": mb_delta,
+            "dispatches": sum(per_beat_disp),
+            "dispatches_per_beat": round(
+                sum(per_beat_disp) / len(per_beat_disp), 3),
+            "megabatches": n_mb,
+            "beat_ms_mean": round(sum(beat_ms) / len(beat_ms), 3),
+            "per_session_beat_ms": round(
+                sum(beat_ms) / len(beat_ms) / n_lanes, 3),
+            "solo_append_ms_mean": round(
+                sum(solo_ms) / len(solo_ms), 3),
+            "tunnel_model": {
+                # the fused beat pays ONE ~100 ms round-trip for all
+                # N lanes; N solo appends pay N — amortization is the
+                # round-trip divided by lanes plus the (shared) fused
+                # host+device beat cost
+                "solo_per_append_ms": round(
+                    sum(solo_ms) / len(solo_ms)
+                    + TUNNEL_ROUNDTRIP_MS, 3),
+                "fused_per_session_ms": round(
+                    (sum(beat_ms) / len(beat_ms)
+                     + TUNNEL_ROUNDTRIP_MS) / n_lanes, 3),
+                "amortization_x": round(
+                    (sum(solo_ms) / len(solo_ms)
+                     + TUNNEL_ROUNDTRIP_MS)
+                    / ((sum(beat_ms) / len(beat_ms)
+                        + TUNNEL_ROUNDTRIP_MS) / n_lanes), 2),
+            },
+        },
         "compile_guard": g.summary(),
     }
     line = json.dumps(result)
@@ -154,6 +252,9 @@ def main() -> int:
     assert flat, (
         f"per-append cost grew with history: head4={head:.1f} ms "
         f"tail4={tail:.1f} ms")
+    mbm = result["megabatch"]["tunnel_model"]
+    assert mbm["fused_per_session_ms"] < mbm["solo_per_append_ms"], \
+        mbm
     if compile_guard.enabled():
         g.assert_closed()
     return 0

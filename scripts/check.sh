@@ -378,13 +378,15 @@ fi
 stage stream "streaming verification sessions smoke (kind:\"stream\")"
 # the live-history path end to end (docs/streaming.md): open a
 # session, append a clean delta (valid-so-far), append a violating
-# delta (INVALID latches — later appends answer immediately), close,
-# clean shutdown, no zombies
+# delta (INVALID latches — later appends answer immediately), two
+# concurrent sessions sharing ONE megabatched dispatch, close,
+# clean shutdown, no zombies. --fill-ms 50 widens the coalescing
+# window so the concurrent appends deterministically share a beat
 ZOMBIES_BEFORE=$(zombie_count)
 STRM_LOG=$(mktemp)
 JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
     --backend cpu --no-prime --frontier 256 \
-    --max-sessions 4 >"$STRM_LOG" 2>&1 &
+    --max-sessions 4 --fill-ms 50 >"$STRM_LOG" 2>&1 &
 STRM_PID=$!
 CLEANUP_PIDS="$STRM_PID"
 for _ in $(seq 200); do
@@ -424,11 +426,44 @@ r = c.stream_append(sid, history_to_edn(clean))
 assert r.get("ok") and r.get("valid") is False and r.get("latched"), r
 r = c.stream_close(sid)
 assert r.get("ok") and r.get("valid") is False, r
+# megabatched advance (docs/streaming.md "Megabatched advance"): two
+# sessions appending in one beat share ONE launched program — the
+# barrier puts both requests inside the daemon's coalescing window
+import threading
+ca = ServiceClient("127.0.0.1", port, timeout_s=300.0, retries=5,
+                   backoff_s=0.5)
+cb = ServiceClient("127.0.0.1", port, timeout_s=300.0, retries=5,
+                   backoff_s=0.5)
+sa = ca.stream_open()["session"]
+sb = cb.stream_open()["session"]
+fused = False
+for attempt in range(3):
+    mb0 = c.status()["status"]["stream_megabatches"]
+    delta = [O.invoke(0, "write", attempt), O.ok(0, "write", attempt),
+             O.invoke(1, "read", None), O.Op(1, "ok", "read", attempt)]
+    bar = threading.Barrier(2)
+    res = {}
+    def go(cli, sid, key):
+        bar.wait()
+        res[key] = cli.stream_append(sid, history_to_edn(delta))
+    ts = [threading.Thread(target=go, args=(ca, sa, "a")),
+          threading.Thread(target=go, args=(cb, sb, "b"))]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert res["a"].get("valid") is True, res
+    assert res["b"].get("valid") is True, res
+    if c.status()["status"]["stream_megabatches"] > mb0:
+        fused = True
+        break
+assert fused, "concurrent same-class appends never shared a dispatch"
+ca.stream_close(sa); cb.stream_close(sb)
+ca.close(); cb.close()
 st = c.status()["status"]
-assert st["stream_opens"] >= 1 and st["stream_appends"] >= 3, st
+assert st["stream_opens"] >= 3 and st["stream_appends"] >= 5, st
 assert st["stream"]["sessions"] == 0, st
 m = c.metrics()
 assert "stream_sessions_active" in m["prometheus"]
+assert "sessions_per_dispatch" in m["prometheus"]
 assert c.shutdown()
 EOF
 wait "$STRM_PID"

@@ -1,0 +1,31 @@
+"""Seeded violation: raw (unbucketed) shapes reach the FUSED
+streaming-session delta entrypoint — the ``stream_delta_megabatch``
+dispatch sink of the ``unbucketed-dispatch-site`` rule. One
+unbucketed lane is worse than the solo case: the megabatch's static
+table dims are shared by the WHOLE group, so a raw memo count seeds a
+fresh program for every same-shape-class batch it ever rides in. The
+raw ``memo.n_states`` is laundered through a helper so only the
+interprocedural chase can tie the call site to the static shape
+argument."""
+
+from comdb2_tpu.stream.engine import stream_delta_megabatch
+
+
+def _dispatch_group(succs, ip, it, okp, dp, offs, carries, n_states,
+                    n_transitions):
+    # the sink: the fused session rung's jit entry with static table
+    # dims taken from the caller's parameters
+    return stream_delta_megabatch(
+        succs, ip, it, okp, dp, offs, carries, F=256, Fs=32, P=4,
+        n_states=n_states, n_transitions=n_transitions)
+
+
+def flush_group(lanes, ip, it, okp, dp, offs):
+    memo = lanes[0].memo
+    succs = tuple(ln.succ_dev for ln in lanes)
+    carries = tuple(ln.carry for ln in lanes)
+    # BUG: raw memo counts, no pad_sizes/next_pow2 — every append
+    # that grew the lead lane's alphabet compiles a fresh fused
+    # program for the entire group
+    return _dispatch_group(succs, ip, it, okp, dp, offs, carries,
+                           memo.n_states, memo.n_transitions)

@@ -1,0 +1,326 @@
+"""Megabatched session advance (docs/streaming.md "Megabatched
+advance"): N same-shape-class sessions advance in ONE device dispatch
+per pump beat, bit-identical to the per-session path.
+
+The load-bearing claims, counter-asserted on
+``stream.engine.DISPATCHES`` (launched PROGRAMS, not lanes) and
+``MEGABATCHES``:
+
+- a fused beat's carries are BIT-equal to B solo dispatches across
+  all three rungs, including mixed per-lane delta sizes (group-max
+  padding: dead ``ok_proc=-1`` segments select the old carry);
+- a latched lane never joins a batch (and never blocks one);
+- a mid-batch escalation re-routes that lane SOLO on the widened
+  pre-delta carry, leaving its batchmates' verdicts untouched;
+- a lane checkpointed out of a fused advance restores bit-exact;
+- the service groups a beat's appends per shape class into one
+  launch, with per-session reply ``stages`` still tiling
+  ``latency_ms``.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.checker.batch import check_batch, pack_batch
+from comdb2_tpu.models.model import MODELS
+from comdb2_tpu.ops import op as O
+from comdb2_tpu.ops.packed import pack_history
+from comdb2_tpu.ops.synth import pinned_wide_history, register_history
+from comdb2_tpu.stream import StreamSession
+from comdb2_tpu.stream import engine as ENG
+
+V = {True: 0, False: 1, "unknown": 2}
+
+
+def _oneshot(h, model="cas-register", F=1024):
+    b = pack_batch([pack_history(list(h))], MODELS[model]())
+    st, fa, nf = check_batch(b, F=F)
+    return int(st[0]), int(fa[0]), int(nf[0])
+
+
+def _assert_verdict(exp, out):
+    got = (V[out["valid"]], out["op_index"], out["final_count"])
+    assert exp[0] == got[0] and exp[1] == got[1], (exp, got)
+    if exp[0] == 0:            # counts compare on VALID only
+        assert exp[2] == got[2], (exp, got)
+
+
+def _fused_beat(sessions, deltas):
+    """Stage every (session, delta) into ONE collector, flush, and
+    finalize — one service pump beat's worth of fused advance."""
+    coll = ENG.MegaBatch()
+    fins = [s.append_stage(d, collector=coll)
+            for s, d in zip(sessions, deltas)]
+    coll.flush()
+    return [f() for f in fins], coll
+
+
+def _assert_state_equal(a, b, path=""):
+    """Recursive bit-exact compare of engine checkpoint trees."""
+    assert type(a) is type(b), (path, type(a), type(b))
+    if isinstance(a, dict):
+        assert a.keys() == b.keys(), path
+        for k in a:
+            _assert_state_equal(a[k], b[k], f"{path}.{k}")
+    elif isinstance(a, (tuple, list)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_state_equal(x, y, f"{path}[{i}]")
+    elif isinstance(a, np.ndarray):
+        np.testing.assert_array_equal(a, b, err_msg=path)
+    else:
+        assert a == b, (path, a, b)
+
+
+def _assert_session_parity(fused, solo):
+    """A fused lane is indistinguishable from its solo twin: same
+    verdict map (incl. per-lane dispatch count) and bit-equal engine
+    carry."""
+    fo, so = fused.poll(), solo.poll()
+    assert fo == so, (fo, so)
+    assert fused.dispatches == solo.dispatches
+    _assert_state_equal(fused.checkpoint()["eng"],
+                        solo.checkpoint()["eng"])
+
+
+# --- bit parity, fused vs solo ---------------------------------------------
+
+def test_xla_fused_bit_parity_mixed_deltas():
+    """Three XLA-rung lanes with DIFFERENT per-beat delta sizes fuse
+    into one program per beat; carries and verdicts are bit-equal to
+    three solo sessions fed identically."""
+    hs = [register_history(random.Random(s), n_procs=3, n_events=36,
+                           p_info=0.0, max_pending=2)
+          for s in (21, 22, 23)]
+    cuts = [24, 12, 30]                  # mixed deltas in each beat
+    fused = [StreamSession("cas-register", engine="xla") for _ in hs]
+    solo = [StreamSession("cas-register", engine="xla") for _ in hs]
+    for part in range(2):
+        beats = [h[:c] if part == 0 else h[c:]
+                 for h, c in zip(hs, cuts)]
+        d0, m0 = ENG.DISPATCHES, ENG.MEGABATCHES
+        outs, coll = _fused_beat(fused, beats)
+        if coll.fused_launches:
+            # one launched program advanced every fused lane
+            assert ENG.DISPATCHES - d0 == len(coll.lane_counts)
+            assert ENG.MEGABATCHES - m0 == coll.fused_launches
+        for s, b in zip(solo, beats):
+            s.append(b)
+    # 3 real lanes pad to the B=4 rung: one duplicated lane, masked
+    assert max(coll.lane_counts) == 3, coll.lane_counts
+    assert coll.masked_lanes >= 1
+    for f, s, h in zip(fused, solo, hs):
+        _assert_session_parity(f, s)
+        exp = _oneshot(h)
+        _assert_verdict(exp, f.finalize_input())
+        _assert_verdict(exp, s.finalize_input())
+
+
+@pytest.fixture()
+def interpret_kernel():
+    from comdb2_tpu.checker import pallas_seg as PS
+
+    PS.use_interpret(True)
+    PS.available.cache_clear()      # pick_rung probes through it
+    yield
+    PS.use_interpret(False)
+    PS.available.cache_clear()
+
+
+def test_kernel_fused_bit_parity(interpret_kernel):
+    """Two kernel-rung lanes (exact kernel as XLA ops) share ONE
+    fused launch per beat — the Mosaic chunk program is invoked per
+    lane inside one jit — and stay bit-equal to solo twins."""
+    def hist(v1, v2):
+        # the second beat interns its new transition WITHIN the
+        # first beat's pow2 buckets (reused values) — a bucket
+        # crossing would re-route solo by design, which is a
+        # different (replay) path than the fused advance under test
+        return ([O.invoke(0, "write", v1), O.ok(0, "write", v1),
+                 O.invoke(1, "write", v2), O.ok(1, "write", v2),
+                 O.invoke(0, "read", None), O.ok(0, "read", v2)],
+                [O.invoke(1, "write", v1), O.ok(1, "write", v1),
+                 O.invoke(0, "read", None), O.ok(0, "read", v1)])
+
+    ha, hb = hist(1, 2), hist(2, 1)
+    fused = [StreamSession("cas-register", engine="kernel")
+             for _ in (0, 1)]
+    solo = [StreamSession("cas-register", engine="kernel")
+            for _ in (0, 1)]
+    for part in range(2):
+        beats = [ha[part], hb[part]]
+        d0 = ENG.DISPATCHES
+        outs, coll = _fused_beat(fused, beats)
+        assert coll.fused_launches == 1, coll.lane_counts
+        assert ENG.DISPATCHES - d0 == 1      # one program, two lanes
+        for s, b in zip(solo, beats):
+            s.append(b)
+    assert all(s._rung == "kernel" for s in fused + solo)
+    for f, s, h in zip(fused, solo, (ha, hb)):
+        _assert_session_parity(f, s)
+        exp = _oneshot(h[0] + h[1])
+        _assert_verdict(exp, f.finalize_input())
+        _assert_verdict(exp, s.finalize_input())
+
+
+def test_mxu_fused_bit_parity():
+    """Two wide-P lanes on the MXU rung advance in one fused launch,
+    bit-equal to solo twins (the packed-word carries stack losslessly
+    and the vmapped chunk scan is elementwise-identical)."""
+    wide = pinned_wide_history(18)
+    tail = [O.invoke(0, "write", 2), O.ok(0, "write", 2),
+            O.invoke(1, "read", None), O.ok(1, "read", 2)]
+    fused = [StreamSession("cas-register", engine="mxu")
+             for _ in (0, 1)]
+    solo = [StreamSession("cas-register", engine="mxu")
+            for _ in (0, 1)]
+    for s in fused + solo:               # wide prefix: solo appends
+        s.append(wide)
+    assert all(s._rung == "mxu" for s in fused + solo)
+    d0, m0 = ENG.DISPATCHES, ENG.MEGABATCHES
+    outs, coll = _fused_beat(fused, [list(tail), list(tail)])
+    assert ENG.DISPATCHES - d0 == 1 and ENG.MEGABATCHES - m0 == 1
+    assert coll.lane_counts == [2]
+    for s in solo:
+        s.append(tail)
+    for f, s in zip(fused, solo):
+        _assert_session_parity(f, s)
+        assert f.poll()["valid"] is True
+
+
+# --- batch-local failure modes ---------------------------------------------
+
+def test_mid_batch_latch():
+    """A lane whose fused delta is non-linearizable latches INVALID
+    without touching its batchmate, and a latched lane never joins a
+    later batch (zero dispatches, the beat's other lane goes solo)."""
+    good = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+            O.invoke(1, "read", None), O.ok(1, "read", 1)]
+    bad = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+           O.invoke(1, "read", None), O.ok(1, "read", 9)]
+    sa = StreamSession("cas-register", engine="xla")
+    sb = StreamSession("cas-register", engine="xla")
+    outs, coll = _fused_beat([sa, sb], [bad, list(good)])
+    assert coll.fused_launches == 1
+    assert outs[0]["valid"] is False      # latched IN the fused run
+    assert outs[1]["valid"] is True
+    # beat 2: the latched lane answers at stage time, no dispatch;
+    # its batchmate advances alone (solo fallback, still one program)
+    more = [O.invoke(2, "write", 2), O.ok(2, "write", 2),
+            O.invoke(0, "read", None), O.ok(0, "read", 2)]
+    d0 = ENG.DISPATCHES
+    da0 = sa.dispatches
+    outs, coll = _fused_beat([sa, sb], [list(more), list(more)])
+    assert outs[0]["valid"] is False and outs[0].get("latched")
+    assert outs[1]["valid"] is True
+    assert sa.dispatches == da0 and ENG.DISPATCHES - d0 == 1
+    assert coll.lane_counts == [1] and coll.fused_launches == 0
+
+
+def test_mid_batch_escalation_reroutes_solo():
+    """A concurrency burst overflowing the first frontier rung inside
+    a fused advance escalates THAT lane in place (widened pre-delta
+    carry, solo re-run) while its batchmate's verdict and carry come
+    straight from the fused program."""
+    burst = []
+    for p in range(8):
+        burst.append(O.invoke(p, "write", p))
+    tail = [O.ok(p, "write", p) for p in range(8)]
+    tail += [O.invoke(0, "read", None), O.ok(0, "read", 7)]
+    calm = register_history(random.Random(31), n_procs=3,
+                            n_events=20, p_info=0.0, max_pending=2)
+    cut = 12
+    sa = StreamSession("cas-register", engine="xla")
+    sb = StreamSession("cas-register", engine="xla")
+    solo_b = StreamSession("cas-register", engine="xla")
+    _fused_beat([sa, sb], [burst, calm[:cut]])
+    solo_b.append(calm[:cut])
+    outs, coll = _fused_beat([sa, sb], [tail, calm[cut:]])
+    solo_b.append(calm[cut:])
+    exp_a = _oneshot(burst + tail, F=8192)
+    out_a = sa.finalize_input()
+    _assert_verdict(exp_a, out_a)
+    assert out_a["frontier_capacity"] > ENG.STREAM_CAPACITIES[0]
+    assert out_a["replays"] == 0         # in place, not a replay
+    _assert_session_parity(sb, solo_b)
+    _assert_verdict(_oneshot(calm), sb.finalize_input())
+
+
+def test_lane_checkpoint_restore_out_of_fused_beat():
+    """A session checkpointed right after a fused advance restores
+    bit-exact and keeps advancing (fused or solo) to the one-shot
+    verdict — migration composes with megabatching."""
+    hs = [register_history(random.Random(s), n_procs=3, n_events=32,
+                           p_info=0.0, max_pending=2)
+          for s in (41, 42)]
+    cut = 16
+    ss = [StreamSession("cas-register", engine="xla") for _ in hs]
+    _fused_beat(ss, [h[:cut] for h in hs])
+    ck = ss[0].checkpoint()
+    moved = StreamSession.restore(ck)
+    _assert_state_equal(ck["eng"], moved.checkpoint()["eng"])
+    outs, coll = _fused_beat([moved, ss[1]],
+                             [h[cut:] for h in hs])
+    assert coll.fused_launches == 1
+    for s, h in zip((moved, ss[1]), hs):
+        _assert_verdict(_oneshot(h), s.finalize_input())
+
+
+# --- the serving plane ------------------------------------------------------
+
+def test_service_fuses_same_class_appends_per_beat():
+    """Two sessions' appends in one service beat share one launched
+    program: `stream_megabatches` counts it, the amortization metrics
+    surface it, and each reply's stages still tile latency_ms."""
+    from comdb2_tpu.obs import trace as obs
+    from comdb2_tpu.ops.history import history_to_edn
+    from comdb2_tpu.service.core import VerifierCore
+
+    h = [O.invoke(0, "write", 1), O.ok(0, "write", 1),
+         O.invoke(1, "read", None), O.ok(1, "read", 1)]
+    core = VerifierCore(batch_cap=8, max_sessions=4)
+    sids = []
+    for i in (1, 2):
+        _, r = core.submit({"kind": "stream", "verb": "open",
+                            "id": i}, obs.monotonic())
+        sids.append(r["session"])
+    now = obs.monotonic()
+    for i, sid in enumerate(sids):
+        core.submit({"kind": "stream", "verb": "append",
+                     "id": 10 + i, "session": sid,
+                     "history": history_to_edn(h)}, now)
+    d0 = ENG.DISPATCHES
+    done = core.tick()
+    assert ENG.DISPATCHES - d0 == 1      # ONE program, two sessions
+    assert core.m["stream_megabatches"] >= 1
+    assert len(done) == 2
+    for _p, rep in done:
+        assert rep["valid"] is True, rep
+        assert abs(sum(rep["stages"].values())
+                   - rep["latency_ms"]) < 1.0
+    prom = core.metrics_reply()["prometheus"]
+    assert "sessions_per_dispatch" in prom
+    assert "stream_megabatch_lanes" in prom
+
+
+def test_compile_guard_closed_over_fused_beats():
+    """Fused advance stays inside the declared inventory (the
+    session_B ladder of PROGRAMS.md stream-delta)."""
+    from comdb2_tpu.utils import compile_guard
+
+    hs = [register_history(random.Random(s), n_procs=3, n_events=24,
+                           p_info=0.0, max_pending=2)
+          for s in (51, 52)]
+    with compile_guard.guard() as g:
+        ss = [StreamSession("cas-register", engine="xla")
+              for _ in hs]
+        for part in range(2):
+            mid = [len(h) // 2 for h in hs]
+            beats = [h[:m] if part == 0 else h[m:]
+                     for h, m in zip(hs, mid)]
+            _fused_beat(ss, beats)
+        for s in ss:
+            s.finalize_input()
+    g.assert_closed()
