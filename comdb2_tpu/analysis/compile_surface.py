@@ -204,6 +204,7 @@ def _ladders() -> dict:
     from ..checker import mxu
     from ..checker import pallas_seg
     from ..checker.linear_jax import make_pack_plan
+    from ..checker.wl import batch as wl_batch
 
     # every PackPlan word count reachable inside the MXU table caps —
     # the chunk form's carry exposes one (F,)-shaped word column per
@@ -249,6 +250,16 @@ def _ladders() -> dict:
         # one program) and the kernel rung's small-delta chunk rungs
         "stream_B": tuple(stream_engine.MEGABATCH_LANES),
         "stream_small_chunks": tuple(pallas_seg.STREAM_CHUNKS),
+        # workload-family ladders (checker/wl/batch.py — the wl-bank/
+        # wl-sets/wl-dirty sites; docs/workloads.md)
+        "wl_batch": tuple(wl_batch.WL_BATCH),
+        "wl_reads": tuple(wl_batch.WL_READS),
+        "wl_accounts": tuple(wl_batch.WL_ACCOUNTS),
+        "wl_snaps": tuple(wl_batch.WL_SNAPS),
+        "wl_elems": tuple(wl_batch.WL_ELEMS),
+        "wl_nodes": tuple(wl_batch.WL_NODES),
+        "wl_values": tuple(wl_batch.WL_VALUES),
+        "wl_delta": tuple(wl_batch.WL_DELTA_PADS),
     }
 
 
@@ -426,6 +437,48 @@ def static_inventory() -> Inventory:
                    + ((one, lane), (res8, lane),
                       (table_rows, lane))) * Bn)
 
+    # workload-family sites (checker/wl, docs/workloads.md): batched
+    # column-plane reductions — no frontier, every jit-visible dim an
+    # enum rung of the WL_* ladders. The delta forms are the stream
+    # rungs (stream/wl.py): solo advance + the megabatched advance
+    # (per-lane carries pass as tuples and stack INSIDE the jit, delta
+    # planes arrive lane-major on the MEGABATCH_LANES ladder).
+    wl_B = Axis("wl_B", "enum", values=L["wl_batch"])
+    wl_R = Axis("wl_reads", "enum", values=L["wl_reads"])
+    wl_A = Axis("wl_accounts", "enum", values=L["wl_accounts"])
+    wl_T = Axis("wl_snaps", "enum", values=L["wl_snaps"])
+    wl_E = Axis("wl_elems", "enum", values=L["wl_elems"])
+    wl_N = Axis("wl_nodes", "enum", values=L["wl_nodes"])
+    wl_V = Axis("wl_values", "enum", values=L["wl_values"])
+    wl_D = Axis("wl_delta", "enum", values=L["wl_delta"])
+    # wl_bank_check(reads, read_mask, wrong_n, init, transfers, total)
+    wl_bank_tmpl = ((wl_B, wl_R, wl_A), (wl_B, wl_R), (wl_B, wl_R),
+                    (wl_B, wl_A), (wl_B, wl_T, wl_A), (wl_B,))
+    # wl_bank_delta(balance, reads, read_mask, wrong_n, transfers,
+    # total-scalar) — delta rows on the WL_DELTA_PADS ladder
+    wl_bank_delta_tmpl = ((wl_A,), (wl_D, wl_A), (wl_D,), (wl_D,),
+                          (wl_D, wl_A), ())
+    wl_bank_mb_tmpls = []
+    for Bn in L["stream_B"]:
+        wl_bank_mb_tmpls.append(
+            ((wl_A,),) * Bn
+            + ((stream_B_ax, wl_D, wl_A), (stream_B_ax, wl_D),
+               (stream_B_ax, wl_D), (stream_B_ax, wl_D, wl_A),
+               (stream_B_ax,)))
+    # wl_sets_check(attempts, adds, final_read, has_read)
+    wl_sets_tmpl = ((wl_B, wl_E),) * 3 + ((wl_B,),)
+    # wl_sets_delta(3 carry planes, 3 delta planes, 2 scalars)
+    wl_sets_delta_tmpl = ((wl_E,),) * 6 + ((), ())
+    wl_sets_mb_tmpls = []
+    for Bn in L["stream_B"]:
+        wl_sets_mb_tmpls.append(
+            ((wl_E,),) * (3 * Bn)
+            + ((stream_B_ax, wl_E),) * 3
+            + ((stream_B_ax,), (stream_B_ax,)))
+    # wl_dirty_check(failed, reads, node_mask, read_mask)
+    wl_dirty_tmpl = ((wl_B, wl_V), (wl_B, wl_R, wl_N),
+                     (wl_B, wl_R, wl_N), (wl_B, wl_R))
+
     sites = (
         Site(
             key="pallas-stream-scan",
@@ -522,6 +575,58 @@ def static_inventory() -> Inventory:
             axes_doc=(stream_delta_ax, stream_K, stream_F_ax,
                       stream_P_ax, stream_B_ax, stream_chunk_ax,
                       memo),
+        ),
+        Site(
+            key="wl-bank",
+            jit_names=("wl_bank_check", "wl_bank_delta",
+                       "wl_bank_delta_mb"),
+            note="bank workload family (checker/wl/bank.py, "
+                 "docs/workloads.md): balance tensors -> wrong-total/"
+                 "wrong-n/snapshot-inconsistency in ONE program. "
+                 "`wl_bank_check` is the post-hoc batch form — lanes "
+                 "on the WL_BATCH ladder, reads/snapshots/accounts on "
+                 "their WL_* rungs (stage_wl_batch buckets; over-rung "
+                 "histories degrade to the host oracle). "
+                 "`wl_bank_delta` is the stream rung's solo advance "
+                 "(carry = the (A,) running balance; delta rows on "
+                 "WL_DELTA_PADS); `wl_bank_delta_mb` is its fused "
+                 "megabatch form — per-lane carries as tuples stacked "
+                 "inside the jit, lane-major deltas on the "
+                 "MEGABATCH_LANES ladder, vmapping the SAME body "
+                 "(bit-identical per lane)",
+            templates=(wl_bank_tmpl, wl_bank_delta_tmpl)
+            + tuple(wl_bank_mb_tmpls),
+            axes_doc=(wl_B, wl_R, wl_A, wl_T, wl_D, stream_B_ax),
+        ),
+        Site(
+            key="wl-sets",
+            jit_names=("wl_sets_check", "wl_sets_delta",
+                       "wl_sets_delta_mb"),
+            note="sets workload family (checker/wl/sets.py): "
+                 "per-element bool membership planes — lost/phantom "
+                 "as bitmap algebra. `wl_sets_check` is the post-hoc "
+                 "batch form (element universe on the WL_ELEMS "
+                 "ladder); `wl_sets_delta`/`wl_sets_delta_mb` are the "
+                 "stream rungs (carry = three (E,) planes; in-place "
+                 "element-rung escalation re-uploads on the next "
+                 "dispatch, past the top rung the session answers "
+                 "terminal UNKNOWN)",
+            templates=(wl_sets_tmpl, wl_sets_delta_tmpl)
+            + tuple(wl_sets_mb_tmpls),
+            axes_doc=(wl_B, wl_E, stream_B_ax),
+        ),
+        Site(
+            key="wl-dirty",
+            jit_names=("wl_dirty_check",),
+            note="dirty-reads workload family (checker/wl/dirty.py): "
+                 "failed-write table joined against read-visibility "
+                 "planes + per-node disagreement in one program. "
+                 "Post-hoc ONLY (the verdict joins reads against the "
+                 "FULL failed-write set — no O(delta) carry exists), "
+                 "so there is no stream rung; value universe on the "
+                 "WL_VALUES ladder, node views on WL_NODES",
+            templates=(wl_dirty_tmpl,),
+            axes_doc=(wl_B, wl_R, wl_N, wl_V),
         ),
         Site(
             key="xla-batch-vmap",
@@ -689,6 +794,56 @@ def _witness_specs():
             fn, st((2, dspec.chunk, 2 + 2 * spec.K)), st((2, 2)),
             (lane, lane))
 
+    def wl_bank_witness():
+        from ..checker.wl import bank as WB
+
+        fn = functools.partial(WB.wl_bank_check, n_reads=8,
+                               n_accounts=8, n_snaps=8)
+        return jax.eval_shape(fn, st((8, 8, 8)),
+                              st((8, 8), np.bool_),
+                              st((8, 8), np.bool_), st((8, 8)),
+                              st((8, 8, 8)), st((8,)))
+
+    def wl_bank_mb_witness():
+        from ..checker.wl import bank as WB
+
+        fn = functools.partial(WB.wl_bank_delta_mb, n_reads=8,
+                               n_accounts=8, n_snaps=8)
+        return jax.eval_shape(fn, (st((8,)),) * 2, st((2, 8, 8)),
+                              st((2, 8), np.bool_),
+                              st((2, 8), np.bool_), st((2, 8, 8)),
+                              st((2,)))
+
+    def wl_sets_witness():
+        from ..checker.wl import sets as WS
+
+        fn = functools.partial(WS.wl_sets_check, n_elems=128)
+        return jax.eval_shape(fn, st((8, 128), np.bool_),
+                              st((8, 128), np.bool_),
+                              st((8, 128), np.bool_),
+                              st((8,), np.bool_))
+
+    def wl_sets_mb_witness():
+        from ..checker.wl import sets as WS
+
+        fn = functools.partial(WS.wl_sets_delta_mb, n_elems=128)
+        lane = (st((128,), np.bool_),) * 3
+        return jax.eval_shape(fn, (lane, lane),
+                              st((2, 128), np.bool_),
+                              st((2, 128), np.bool_),
+                              st((2, 128), np.bool_),
+                              st((2,), np.bool_), st((2,), np.bool_))
+
+    def wl_dirty_witness():
+        from ..checker.wl import dirty as WD
+
+        fn = functools.partial(WD.wl_dirty_check, n_reads=8,
+                               n_nodes=4, n_values=128)
+        return jax.eval_shape(fn, st((8, 128), np.bool_),
+                              st((8, 8, 4)),
+                              st((8, 8, 4), np.bool_),
+                              st((8, 8), np.bool_))
+
     def _witness_mesh():
         # a 1-device mesh: available on every platform, and the D=1
         # rung keeps the artifact deterministic across environments
@@ -759,6 +914,18 @@ def _witness_specs():
          "stream_kernel_delta_mb: spec_for(8,32,P=4,K=2) at "
          "delta_spec chunk=64, session_B=2",
          stream_kernel_mb_witness),
+        ("wl-bank",
+         "wl_bank_check at B=8 R=8 A=8 T=8", wl_bank_witness),
+        ("wl-bank",
+         "wl_bank_delta_mb: delta=8 A=8 fused at session_B=2",
+         wl_bank_mb_witness),
+        ("wl-sets",
+         "wl_sets_check at B=8 E=128", wl_sets_witness),
+        ("wl-sets",
+         "wl_sets_delta_mb: E=128 fused at session_B=2",
+         wl_sets_mb_witness),
+        ("wl-dirty",
+         "wl_dirty_check at B=8 R=8 N=4 V=128", wl_dirty_witness),
         ("txn-closure", "closure bucket N=16", closure_witness),
         ("txn-closure",
          "closure_diag_kernel_sharded: B=2 N=16, D=1 mesh rung",
@@ -904,6 +1071,28 @@ def render_programs() -> str:
         f"{list(L['stream_small_chunks'])} | "
         "`pallas_seg.STREAM_CHUNKS` (`delta_spec` small-delta rungs "
         "under the stream jit names; base chunks stay spec_for's) |",
+        f"| wl batch B | {list(L['wl_batch'])} | "
+        "`checker.wl.batch.WL_BATCH` (histories per dispatch; bigger "
+        "batches chunk, short ones pad by duplicating lane 0) |",
+        f"| wl reads | {list(L['wl_reads'])} | "
+        "`checker.wl.batch.WL_READS` (bank + dirty ok-read rows per "
+        "history; over-rung degrades to the host oracle) |",
+        f"| wl accounts | {list(L['wl_accounts'])} | "
+        "`checker.wl.batch.WL_ACCOUNTS` (bank balance-row width) |",
+        f"| wl snapshots | {list(L['wl_snaps'])} | "
+        "`checker.wl.batch.WL_SNAPS` (bank transfer rows; snapshot "
+        "plane depth is T + 1) |",
+        f"| wl elements | {list(L['wl_elems'])} | "
+        "`checker.wl.batch.WL_ELEMS` (sets element universe; stream "
+        "sessions escalate IN PLACE up this ladder) |",
+        f"| wl nodes | {list(L['wl_nodes'])} | "
+        "`checker.wl.batch.WL_NODES` (dirty per-read node views) |",
+        f"| wl values | {list(L['wl_values'])} | "
+        "`checker.wl.batch.WL_VALUES` (dirty distinct-value "
+        "universe) |",
+        f"| wl delta rows | {list(L['wl_delta'])} | "
+        "`checker.wl.batch.WL_DELTA_PADS` (stream-rung per-append "
+        "read/transfer row pads; oversized appends chunk solo) |",
         "",
         "## Dispatch sites",
         "",
@@ -991,6 +1180,24 @@ SHAPE_SINKS: Dict[str, dict] = {
                                           "n_transitions")},
     "check_device_mxu_megabatch": {"kwargs": ("n_states",
                                               "n_transitions")},
+    # workload-family sinks (checker/wl): every static dim must come
+    # off a WL_* ladder (stage_wl_batch/_dims bucket; stream/wl.py
+    # sessions carry pre-bucketed pads) — a raw count here compiles
+    # one program per distinct history shape, same hazard as the
+    # frontier engines
+    "wl_bank_check": {"kwargs": ("n_reads", "n_accounts",
+                                 "n_snaps")},
+    "wl_bank_delta": {"kwargs": ("n_reads", "n_accounts",
+                                 "n_snaps")},
+    "wl_bank_delta_mb": {"kwargs": ("n_reads", "n_accounts",
+                                    "n_snaps")},
+    "wl_sets_check": {"kwargs": ("n_elems",)},
+    "wl_sets_delta": {"kwargs": ("n_elems",)},
+    "wl_sets_delta_mb": {"kwargs": ("n_elems",)},
+    "wl_dirty_check": {"kwargs": ("n_reads", "n_nodes",
+                                  "n_values")},
+    "check_wl_batch": {"kwargs": ("b_pad",)},
+    "stage_wl_batch": {"kwargs": ("b_pad",)},
 }
 
 #: callables that PRODUCE bucketed values

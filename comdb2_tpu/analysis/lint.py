@@ -107,12 +107,18 @@ PACK_SEGMENT_MODULES = {"packed.py", "columnar.py",
                         # pays the pass PER APPEND, forever)
                         "ingest.py", "segment.py"}
 
+#: package directories whose EVERY module is pack/segment scope —
+#: checker/wl encodes whole batches into column planes (encoders,
+#: delta builders, verdict decoders), so a ``.ops`` loop anywhere in
+#: it is the same hazard
+PACK_SEGMENT_DIRS = {"wl"}
+
 #: the dispatch-pipeline scope of ``raw-clock-in-pipeline``: package
 #: directories plus the checker dispatch modules (files whose
 #: basename contains "dispatch" are included so the seeded fixture
 #: and future dispatch helpers are covered); ``obs`` is the clock's
 #: home and exempt
-RAW_CLOCK_DIRS = {"service", "shrink", "txn", "stream"}
+RAW_CLOCK_DIRS = {"service", "shrink", "txn", "stream", "wl"}
 RAW_CLOCK_FILES = {"linear.py", "batch.py", "pallas_seg.py"}
 RAW_CLOCK_FNS = {"time", "monotonic", "perf_counter"}
 
@@ -577,7 +583,9 @@ def lint_file(path: str, source: Optional[str] = None, *,
                              and "comdb2_tpu" in parts)):
         raw += _checkpoint_findings(tree, info, path)
 
-    if base in PACK_SEGMENT_MODULES or "pack" in base:
+    if (base in PACK_SEGMENT_MODULES or "pack" in base
+            or (not in_tests and set(parts) & PACK_SEGMENT_DIRS
+                and "comdb2_tpu" in parts)):
         for ln in info.ops_loops:
             raw.append(Finding(
                 "per-op-host-loop", path, ln,

@@ -1,0 +1,38 @@
+"""Device workload-checker families — bank / sets / dirty-reads.
+
+The full Jepsen checker suite beside the register tester
+(PAPER.md §1.1), lowered from the per-op host loops in
+``checker/workloads.py`` to batched tensor reductions: none of these
+needs a frontier search, so a whole batch of histories is ONE jit per
+pow2 bucket (``check_wl_batch``), the service serves them as
+``kind:"wl"``, and bank/sets run live as stream-session rungs
+(``comdb2_tpu.stream.wl``). The host checkers remain as parity
+oracles — golden tests assert bit-agreement on every seeded
+valid/violation twin. docs/workloads.md has the family semantics,
+tensor layouts, and violation taxonomy.
+"""
+
+from .bank import (BankColumns, bank_verdicts, default_init,
+                   encode_bank, wl_bank_check, wl_bank_delta,
+                   wl_bank_delta_mb)
+from .batch import (FAMILIES, WL_ACCOUNTS, WL_BATCH, WL_DELTA_PADS,
+                    WL_ELEMS, WL_NODES, WL_READS, WL_SNAPS,
+                    WL_VALUES, bucket_of, check_wl_batch,
+                    stage_wl_batch, wl_dims)
+from .dirty import (DirtyColumns, dirty_verdicts, encode_dirty,
+                    is_malformed_read, wl_dirty_check)
+from .sets import (SetsColumns, encode_sets, sets_verdicts,
+                   wl_sets_check, wl_sets_delta, wl_sets_delta_mb)
+from .synth import bank_batch, dirty_batch, sets_batch
+
+__all__ = ["BankColumns", "DirtyColumns", "FAMILIES", "SetsColumns",
+           "WL_ACCOUNTS", "WL_BATCH", "WL_DELTA_PADS", "WL_ELEMS",
+           "WL_NODES", "WL_READS", "WL_SNAPS", "WL_VALUES",
+           "bank_batch", "bank_verdicts", "bucket_of",
+           "check_wl_batch", "default_init", "dirty_batch",
+           "dirty_verdicts", "encode_bank", "encode_dirty",
+           "encode_sets", "is_malformed_read", "sets_batch",
+           "sets_verdicts", "stage_wl_batch", "wl_bank_check",
+           "wl_bank_delta", "wl_bank_delta_mb", "wl_dims",
+           "wl_dirty_check", "wl_sets_check", "wl_sets_delta",
+           "wl_sets_delta_mb"]

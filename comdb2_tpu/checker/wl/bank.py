@@ -1,0 +1,251 @@
+"""Bank workload as a batched tensor family.
+
+The Jepsen bank test moves money between ``n`` accounts with
+``transfer`` ops and reads all balances at once; the invariant is that
+every read sees exactly ``n`` balances summing to the model total
+(``comdb2/core.clj:152-177``, :class:`~..workloads.BankChecker`). No
+frontier search is needed — the whole check is a masked row-sum
+reduction, so a batch of histories is one jit.
+
+Tensor layout (axis 0 = history lane, all dims pow2-padded from the
+``checker.wl.batch`` ladders):
+
+- ``reads``      int32[B, R, A]  — ok-read balance rows (0-padded)
+- ``read_mask``  bool[B, R]      — real read rows
+- ``wrong_n``    bool[B, R]      — host-flagged ragged rows (a read
+  with the wrong account count cannot be laid out in (A,) faithfully;
+  the flag rides into the device reduction so the verdict is still a
+  single device readback)
+- ``init``       int32[B, A]     — starting balances
+- ``transfers``  int32[B, T, A]  — per-ok-transfer account deltas
+  (0-padded rows are no-ops)
+- ``total``      int32[B]
+
+All-int32 on device: this env runs without x64, and bank balances are
+bounded by the model total (the encoder range-checks).
+
+Beyond the oracle's wrong-n / wrong-total, the device also proves a
+DIAGNOSTIC snapshot-inconsistency plane: prefix snapshots
+``S_t = init + cumsum(transfers)[:t]`` (t = 0..T) are the only states
+a serializable bank can ever expose, so a read matching NO ``S_t``
+observed a mid-transfer (fractured) state even when its total happens
+to balance. Like the dirty-reads oracle's ``inconsistent-reads``, it
+does not affect ``valid?`` — the device verdict stays bit-identical to
+:class:`~..workloads.BankChecker`.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class BankColumns(NamedTuple):
+    """Encoded bank histories (see module docstring). ``read_index``
+    maps read rows back to op indices for counterexample reporting."""
+    reads: np.ndarray       # int32[B, R, A]
+    read_mask: np.ndarray   # bool[B, R]
+    wrong_n: np.ndarray     # bool[B, R]
+    wrong_len: np.ndarray   # int32[B, R] — found length of wrong-n rows
+    init: np.ndarray        # int32[B, A]
+    transfers: np.ndarray   # int32[B, T, A]
+    total: np.ndarray       # int32[B]
+    read_index: np.ndarray  # int32[B, R] — op index of each read row
+    n: int                  # the model's account count (un-padded)
+
+
+def default_init(model: dict) -> List[int]:
+    """Starting balances: the model's ``init`` when present, else the
+    Jepsen default of an even split (remainder on account 0)."""
+    n, total = int(model["n"]), int(model["total"])
+    if "init" in model:
+        init = [int(x) for x in model["init"]]
+        if len(init) != n or sum(init) != total:
+            raise ValueError("model init must hold n balances summing "
+                             "to total")
+        return init
+    per = total // n
+    return [total - per * (n - 1)] + [per] * (n - 1)
+
+
+def encode_bank(histories: Sequence[Sequence], model: dict, *,
+                r_pad: int, a_pad: int, t_pad: int) -> BankColumns:
+    """Host encode: one pass per history over its ops into the padded
+    column planes. ``transfer`` op values are ``(frm, to, amount)``."""
+    B = len(histories)
+    n = int(model["n"])
+    if a_pad < n:
+        raise ValueError(f"a_pad {a_pad} < model n {n}")
+    if abs(int(model["total"])) >= 1 << 30:
+        raise ValueError("bank totals must fit int32 (no x64 here)")
+    init_row = default_init(model)
+    reads = np.zeros((B, r_pad, a_pad), np.int32)
+    read_mask = np.zeros((B, r_pad), bool)
+    wrong_n = np.zeros((B, r_pad), bool)
+    wrong_len = np.zeros((B, r_pad), np.int32)
+    read_index = np.full((B, r_pad), -1, np.int32)
+    transfers = np.zeros((B, t_pad, a_pad), np.int32)
+    init = np.zeros((B, a_pad), np.int32)
+    init[:, :n] = init_row
+    total = np.full(B, int(model["total"]), np.int32)
+    for b, hist in enumerate(histories):
+        r = t = 0
+        for i, op in enumerate(hist):
+            if op.type != "ok" or op.value is None:
+                continue
+            if op.f == "read":
+                row = list(op.value)
+                if r >= r_pad:
+                    raise ValueError(f"history {b}: > {r_pad} reads")
+                read_mask[b, r] = True
+                read_index[b, r] = i if op.index is None else op.index
+                if len(row) != n:
+                    wrong_n[b, r] = True
+                    wrong_len[b, r] = len(row)
+                else:
+                    reads[b, r, :n] = row
+                r += 1
+            elif op.f == "transfer":
+                frm, to, amt = op.value
+                if t >= t_pad:
+                    raise ValueError(
+                        f"history {b}: > {t_pad} transfers")
+                transfers[b, t, int(frm)] -= int(amt)
+                transfers[b, t, int(to)] += int(amt)
+                t += 1
+    return BankColumns(reads, read_mask, wrong_n, wrong_len, init,
+                       transfers, total, read_index, n)
+
+
+@functools.partial(jax.jit, static_argnames=("n_reads", "n_accounts",
+                                             "n_snaps"))
+def wl_bank_check(reads, read_mask, wrong_n, init, transfers, total,
+                  *, n_reads: int, n_accounts: int, n_snaps: int):
+    """One batched bank verdict. Shapes are drawn from the closed
+    ``wl-bank`` ladder (PROGRAMS.md); the static kwargs restate the
+    padded dims so call sites are auditable by the
+    ``unbucketed-dispatch-site`` rule."""
+    assert reads.shape == (reads.shape[0], n_reads, n_accounts)
+    assert transfers.shape[1] == n_snaps
+    sums = jnp.sum(reads, axis=2)                              # (B,R)
+    wrong_total = read_mask & ~wrong_n & (sums != total[:, None])
+    bad = read_mask & (wrong_n | wrong_total)
+    # snapshot plane: S_0 = init, S_t = init + cumsum(transfers)[t-1]
+    snaps = jnp.concatenate(
+        [jnp.zeros_like(transfers[:, :1]),
+         jnp.cumsum(transfers, axis=1)],
+        axis=1) + init[:, None, :]                          # (B,T+1,A)
+
+    def any_match(seen, snap_t):                            # (B,A)
+        m = jnp.all(reads == snap_t[:, None, :], axis=2)    # (B,R)
+        return seen | m, None
+
+    seen, _ = lax.scan(any_match,
+                       jnp.zeros(read_mask.shape, bool),
+                       jnp.moveaxis(snaps, 1, 0))
+    snap_bad = read_mask & ~wrong_n & ~seen
+    any_bad = jnp.any(bad, axis=1)
+    first_bad = jnp.where(any_bad, jnp.argmax(bad, axis=1), -1)
+    return (~any_bad, wrong_total, snap_bad, first_bad, sums)
+
+
+def _bank_delta_body(balance, reads, read_mask, wrong_n, transfers,
+                     total):
+    """One LANE's bank delta against its running-balance carry. Shared
+    verbatim between the solo jit and the vmapped megabatch form so a
+    fused advance is bit-identical to the solo one. Snapshot depth
+    counts from the carry: ``S_0 = balance`` (the pre-delta state is a
+    legal read), ``S_t = balance + cumsum(transfers)[t-1]``."""
+    snaps = jnp.concatenate(
+        [jnp.zeros_like(transfers[:1]),
+         jnp.cumsum(transfers, axis=0)], axis=0) + balance[None, :]
+    new_balance = snaps[-1]
+    sums = jnp.sum(reads, axis=1)                               # (R,)
+    wrong_total = read_mask & ~wrong_n & (sums != total)
+    bad = read_mask & (wrong_n | wrong_total)
+
+    def any_match(seen, snap_t):
+        return seen | jnp.all(reads == snap_t[None, :], axis=1), None
+
+    seen, _ = lax.scan(any_match, jnp.zeros(read_mask.shape, bool),
+                       snaps)
+    snap_bad = read_mask & ~wrong_n & ~seen
+    any_bad = jnp.any(bad)
+    first_bad = jnp.where(any_bad, jnp.argmax(bad), -1)
+    return (new_balance, any_bad, first_bad, jnp.sum(bad),
+            jnp.sum(snap_bad))
+
+
+@functools.partial(jax.jit, static_argnames=("n_reads", "n_accounts",
+                                             "n_snaps"))
+def wl_bank_delta(balance, reads, read_mask, wrong_n, transfers,
+                  total, *, n_reads: int, n_accounts: int,
+                  n_snaps: int):
+    """Stream-rung solo advance: O(delta) — the carry is the (A,)
+    running balance, the delta planes are this append's reads and
+    transfer rows padded up ``WL_DELTA_PADS`` (the ``wl-bank-delta``
+    ladder, PROGRAMS.md)."""
+    assert reads.shape == (n_reads, n_accounts)
+    assert transfers.shape == (n_snaps, n_accounts)
+    return _bank_delta_body(balance, reads, read_mask, wrong_n,
+                            transfers, total)
+
+
+@functools.partial(jax.jit, static_argnames=("n_reads", "n_accounts",
+                                             "n_snaps"))
+def wl_bank_delta_mb(balances, reads, read_mask, wrong_n, transfers,
+                     totals, *, n_reads: int, n_accounts: int,
+                     n_snaps: int):
+    """Megabatched advance: ``balances`` is a TUPLE of per-lane
+    device carries (stacked INSIDE the jit — eager host stacking of
+    device arrays would compile an off-inventory infra program); the
+    delta planes arrive host-stacked with a lane axis. Returns one
+    output tuple per lane, vmapping the SAME body as the solo form —
+    bit-identical per lane."""
+    bal = jnp.stack(balances)
+    assert reads.shape == (bal.shape[0], n_reads, n_accounts)
+    assert transfers.shape[1] == n_snaps
+    outs = jax.vmap(_bank_delta_body)(bal, reads, read_mask, wrong_n,
+                                      transfers, totals)
+    return tuple(tuple(o[i] for o in outs)
+                 for i in range(len(balances)))
+
+
+def bank_verdicts(cols: BankColumns, out) -> List[dict]:
+    """Decode one device readback into per-history oracle-shaped
+    verdict dicts (the ``bad-reads`` taxonomy of
+    :class:`~..workloads.BankChecker`, plus the snapshot plane)."""
+    valid, wrong_total, snap_bad, first_bad, sums = \
+        (np.asarray(x) for x in out)
+    verdicts = []
+    for b in range(cols.read_mask.shape[0]):
+        bad_reads = []
+        for r in np.flatnonzero(cols.read_mask[b]):
+            if cols.wrong_n[b, r]:
+                bad_reads.append({"type": "wrong-n",
+                                  "expected": cols.n,
+                                  "found": int(cols.wrong_len[b, r]),
+                                  "index": int(cols.read_index[b, r])})
+            elif wrong_total[b, r]:
+                bad_reads.append({"type": "wrong-total",
+                                  "expected": int(cols.total[b]),
+                                  "found": int(sums[b, r]),
+                                  "index": int(cols.read_index[b, r])})
+        snaps = [int(cols.read_index[b, r])
+                 for r in np.flatnonzero(snap_bad[b])]
+        verdicts.append({"valid?": bool(valid[b]),
+                         "bad-reads": bad_reads,
+                         "snapshot-inconsistent": snaps,
+                         "first-bad-read": int(first_bad[b])})
+    return verdicts
+
+
+__all__ = ["BankColumns", "bank_verdicts", "default_init",
+           "encode_bank", "wl_bank_check", "wl_bank_delta",
+           "wl_bank_delta_mb"]

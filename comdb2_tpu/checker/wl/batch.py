@@ -1,0 +1,233 @@
+"""Batched entry for the workload-checker families.
+
+``check_wl_batch`` is the one dispatch surface: encode a batch of
+histories into the family's column planes, pad every jit-visible dim
+up its declared ladder, and launch ONE program per pow2 bucket
+(``DISPATCHES`` counts launches; tests assert one per bucket). The
+ladders below are the ``wl-<family>`` rows of PROGRAMS.md — the
+compile guard closes over them, so every rung pair is a program the
+daemon may prime and nothing else ever compiles.
+
+Histories that exceed the top rung of a per-history axis fall back to
+the HOST ORACLE (the demoted ``workloads.py`` checkers) — same
+verdict, ``engine: "host"`` attribution, no open-ended program.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .bank import bank_verdicts, encode_bank, wl_bank_check
+from .dirty import dirty_verdicts, encode_dirty, wl_dirty_check
+from .sets import encode_sets, sets_verdicts, wl_sets_check
+
+#: the checker families this subsystem serves
+FAMILIES = ("bank", "sets", "dirty")
+
+#: batch-lane rungs (histories per dispatch; bigger batches chunk)
+WL_BATCH = (1, 8, 64, 512)
+#: ok-read rows per history (bank + dirty)
+WL_READS = (8, 64, 512)
+#: bank account columns
+WL_ACCOUNTS = (8, 32, 128)
+#: bank transfer rows (snapshot plane depth is T + 1)
+WL_SNAPS = (8, 64, 512)
+#: sets element-universe width
+WL_ELEMS = (128, 1024, 8192)
+#: dirty per-read node views
+WL_NODES = (4, 16)
+#: dirty distinct-value universe width
+WL_VALUES = (128, 1024, 8192)
+#: stream-rung per-APPEND row pads (bank delta reads / transfers) —
+#: an append past the top rung dispatches in sequential solo chunks
+WL_DELTA_PADS = (8, 64)
+
+#: launched wl programs (one per pow2 bucket — the amortization claim
+#: tests assert against this, exactly like stream.engine.DISPATCHES)
+DISPATCHES = 0
+
+
+def bucket_of(n: int, ladder: Tuple[int, ...]) -> int:
+    """The smallest rung >= n (None past the top — the caller routes
+    host). Shares its name with the sanctioned bucketing helpers the
+    ``unbucketed-dispatch-site`` rule recognizes."""
+    for p in ladder:
+        if p >= n:
+            return p
+    return None
+
+
+def _dims(histories, family: str, model: Optional[dict]):
+    """Per-batch padded dims (max over lanes, bucketed), or None when
+    any per-history axis exceeds its top rung."""
+    n_reads = n_elems = n_nodes = n_vals = n_snaps = 1
+    for hist in histories:
+        r = t = 0
+        elems = set()
+        vals = set()
+        for op in hist:
+            if op.value is None:
+                continue
+            if family == "bank":
+                if op.type == "ok" and op.f == "read":
+                    r += 1
+                elif op.type == "ok" and op.f == "transfer":
+                    t += 1
+            elif family == "sets":
+                if op.f == "add":
+                    elems.add(_key(op.value))
+                elif op.type == "ok" and op.f == "read":
+                    elems |= {_key(v) for v in op.value}
+            elif family == "dirty":
+                if op.f == "write":
+                    vals.add(_key(op.value))
+                elif op.type == "ok" and op.f == "read":
+                    r += 1
+                    if not isinstance(op.value, (str, bytes)) \
+                            and isinstance(op.value, (list, tuple)):
+                        n_nodes = max(n_nodes, len(op.value))
+                        vals |= {_key(v) for v in op.value}
+        n_reads = max(n_reads, r)
+        n_snaps = max(n_snaps, t)
+        n_elems = max(n_elems, len(elems))
+        n_vals = max(n_vals, len(vals))
+    if family == "bank":
+        a = int(model["n"]) if model else 1
+        dims = {"r_pad": bucket_of(n_reads, WL_READS),
+                "a_pad": bucket_of(a, WL_ACCOUNTS),
+                "t_pad": bucket_of(n_snaps, WL_SNAPS)}
+    elif family == "sets":
+        dims = {"e_pad": bucket_of(n_elems, WL_ELEMS)}
+    else:
+        dims = {"r_pad": bucket_of(n_reads, WL_READS),
+                "n_pad": bucket_of(n_nodes, WL_NODES),
+                "v_pad": bucket_of(n_vals, WL_VALUES)}
+    if any(v is None for v in dims.values()):
+        return None
+    return dims
+
+
+def _key(v):
+    from ..workloads import freeze_value
+
+    return freeze_value(v)
+
+
+def _host_fallback(histories, family: str,
+                   model: Optional[dict]) -> List[dict]:
+    from ..checkers import check_safe, set_checker
+    from ..workloads import bank_checker, dirty_reads_checker
+
+    chk = {"bank": bank_checker, "sets": set_checker,
+           "dirty": dirty_reads_checker}[family]
+    out = []
+    for hist in histories:
+        v = check_safe(chk, {}, model, list(hist))
+        v["engine"] = "host"
+        out.append(v)
+    return out
+
+
+def stage_wl_batch(histories: Sequence[Sequence], family: str,
+                   model: Optional[dict] = None, *,
+                   b_pad: Optional[int] = None,
+                   dims: Optional[dict] = None):
+    """Encode one bucket's batch and LAUNCH its device program;
+    returns a zero-arg finalize whose call is the readback point
+    (the verdict list, padded lanes sliced off). This is the
+    stage/finish seam the service ring overlaps host packing against
+    — same contract as ``checker.batch.check_batch_async``. ``dims``
+    pins the padded per-history axes (the service passes its
+    WlBucket's, so every chunk of a bucket reuses one program);
+    without it the batch max is measured and bucketed here. Raises
+    ``ValueError`` on unknown family / missing bank model; a batch
+    past the rungs (or an encode-time overflow) finalizes through the
+    host oracle instead."""
+    global DISPATCHES
+    if family not in FAMILIES:
+        raise ValueError(f"unknown wl family {family!r}")
+    if family == "bank" and (model is None or "n" not in model
+                             or "total" not in model):
+        raise ValueError("bank needs a model {'n':..,'total':..}")
+    histories = [list(h) for h in histories]
+    if not histories:
+        return lambda: []
+    if len(histories) > WL_BATCH[-1]:
+        raise ValueError(
+            f"batch of {len(histories)} exceeds the top WL_BATCH "
+            f"rung ({WL_BATCH[-1]}) — chunk first (check_wl_batch "
+            "does)")
+    if dims is None:
+        dims = _dims(histories, family, model)
+    if dims is None or any(v is None for v in dims.values()):
+        return lambda: _host_fallback(histories, family, model)
+    B = len(histories)
+    bp = b_pad if b_pad is not None else bucket_of(B, WL_BATCH)
+    # pad lanes by duplicating lane 0 (same trick as the megabatch
+    # collector) — padded verdicts are sliced off before return
+    padded = histories + [histories[0]] * (bp - B)
+    try:
+        if family == "bank":
+            cols = encode_bank(padded, model, **dims)
+            out = wl_bank_check(
+                cols.reads, cols.read_mask, cols.wrong_n, cols.init,
+                cols.transfers, cols.total,
+                n_reads=dims["r_pad"], n_accounts=dims["a_pad"],
+                n_snaps=dims["t_pad"])
+            DISPATCHES += 1
+            return lambda: bank_verdicts(cols, out)[:B]
+        if family == "sets":
+            cols = encode_sets(padded, **dims)
+            out = wl_sets_check(cols.attempts, cols.adds,
+                                cols.final_read, cols.has_read,
+                                n_elems=dims["e_pad"])
+            DISPATCHES += 1
+            return lambda: sets_verdicts(cols, out)[:B]
+        cols = encode_dirty(padded, **dims)
+        out = wl_dirty_check(cols.failed, cols.reads, cols.node_mask,
+                             cols.read_mask,
+                             n_reads=dims["r_pad"],
+                             n_nodes=dims["n_pad"],
+                             n_values=dims["v_pad"])
+        DISPATCHES += 1
+        return lambda: dirty_verdicts(cols, out)[:B]
+    except ValueError:
+        # encode-time overflow (a lane past a per-history cap the
+        # pre-scan could not see, e.g. interning growth) — host route
+        return lambda: _host_fallback(histories, family, model)
+
+
+def check_wl_batch(histories: Sequence[Sequence], family: str,
+                   model: Optional[dict] = None, *,
+                   b_pad: Optional[int] = None) -> List[dict]:
+    """Check a batch of one family's histories on device — one
+    program per pow2 bucket (:func:`stage_wl_batch` staged and
+    finalized in one step). ``model`` is the bank model dict
+    (``{"n": .., "total": ..}``); other families take None. ``b_pad``
+    forces the batch rung; by default lanes bucket up ``WL_BATCH``
+    and over-top batches chunk."""
+    histories = [list(h) for h in histories]
+    top = WL_BATCH[-1]
+    if len(histories) > top:
+        out = []
+        for i in range(0, len(histories), top):
+            out.extend(check_wl_batch(histories[i:i + top], family,
+                                      model, b_pad=top))
+        return out
+    return stage_wl_batch(histories, family, model, b_pad=b_pad)()
+
+
+def wl_dims(histories, family: str,
+            model: Optional[dict] = None) -> Optional[dict]:
+    """Padded per-history axes for a batch (max over lanes, bucketed
+    up the family's ladders), or None when any axis exceeds its top
+    rung — the service's bucket derivation (``wl_bucket_for``)."""
+    return _dims([list(h) for h in histories], family, model)
+
+
+__all__ = ["DISPATCHES", "FAMILIES", "WL_ACCOUNTS", "WL_BATCH",
+           "WL_DELTA_PADS", "WL_ELEMS", "WL_NODES", "WL_READS",
+           "WL_SNAPS", "WL_VALUES", "bucket_of", "check_wl_batch",
+           "stage_wl_batch", "wl_dims"]

@@ -1,0 +1,189 @@
+"""Sets workload as per-element bitmap membership algebra.
+
+The Jepsen set test adds elements and reads the whole set back once at
+the end; the verdict is pure set algebra over three populations
+(``checker.clj:108-154``, :class:`~..checkers.SetChecker`):
+
+- lost       = acked adds the final read never returned
+- unexpected = read-back elements nobody ever attempted (phantoms)
+- recovered  = attempted-not-acked adds that surfaced anyway (legal)
+
+On device each history lane is three element bitmaps over a
+host-interned id space (first-occurrence order, exactly like the
+packer's value tables): ``attempts`` / ``adds`` / ``final_read``
+bool[B, E]. The whole batch verdict is a handful of fused masked
+reductions — no frontier, no sort. ``E`` comes from the ``WL_ELEMS``
+ladder; histories agreeing on the rung share one program.
+
+A history with no ok read answers UNKNOWN ("Set was never read") on
+the host side, mirroring the oracle — its lane still rides the
+dispatch (masked out) so the batch stays one program.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SetsColumns(NamedTuple):
+    attempts: np.ndarray    # bool[B, E]
+    adds: np.ndarray        # bool[B, E]
+    final_read: np.ndarray  # bool[B, E]
+    has_read: np.ndarray    # bool[B]
+    tables: tuple           # per-lane id -> element value
+
+
+def encode_sets(histories: Sequence[Sequence], *,
+                e_pad: int) -> SetsColumns:
+    """Host encode: intern each lane's element values (adds AND read
+    contents — a phantom element appears only in the read) in
+    first-occurrence order, then set bitmap bits."""
+    B = len(histories)
+    attempts = np.zeros((B, e_pad), bool)
+    adds = np.zeros((B, e_pad), bool)
+    final_read = np.zeros((B, e_pad), bool)
+    has_read = np.zeros(B, bool)
+    tables = []
+    for b, hist in enumerate(histories):
+        ids: dict = {}
+
+        def eid(v):
+            i = ids.get(v)
+            if i is None:
+                i = ids[v] = len(ids)
+                if i >= e_pad:
+                    raise ValueError(
+                        f"history {b}: > {e_pad} distinct elements")
+            return i
+
+        last_read = None
+        for op in hist:
+            if op.f == "add" and op.value is not None:
+                i = eid(op.value)
+                if op.type == "invoke":
+                    attempts[b, i] = True
+                elif op.type == "ok":
+                    # an acked add is by definition attempted, even in
+                    # completion-only histories with no invoke events
+                    attempts[b, i] = True
+                    adds[b, i] = True
+            elif (op.f == "read" and op.type == "ok"
+                    and op.value is not None):
+                last_read = op.value
+        if last_read is not None:
+            has_read[b] = True
+            for v in last_read:
+                final_read[b, eid(v)] = True
+        tables.append(tuple(ids))
+    return SetsColumns(attempts, adds, final_read, has_read,
+                       tuple(tables))
+
+
+@functools.partial(jax.jit, static_argnames=("n_elems",))
+def wl_sets_check(attempts, adds, final_read, has_read, *,
+                  n_elems: int):
+    """One batched sets verdict over bool[B, E] membership planes
+    (``wl-sets`` ladder, PROGRAMS.md)."""
+    assert attempts.shape[1] == n_elems
+    ok = final_read & attempts
+    unexpected = final_read & ~attempts
+    lost = adds & ~final_read
+    recovered = ok & ~adds
+    valid = has_read & ~jnp.any(lost | unexpected, axis=1)
+    return (valid, ok, lost, unexpected, recovered)
+
+
+def _sets_delta_body(attempts, adds, final_read, attempts_d, adds_d,
+                     read_d, has_read_d, has_read):
+    """One LANE's sets delta against its bitmap-plane carry. Shared
+    verbatim between the solo jit and the vmapped megabatch form.
+    ``has_read_d`` (this delta read) and ``has_read`` (union INCLUDING
+    this delta) are host-computed scalars — an empty-set read is still
+    a read, so presence can't be inferred from ``read_d``. A read
+    REPLACES ``final_read`` (last-read-wins, matching the one-shot
+    encoder), which is why the sets verdict is only provisional until
+    close."""
+    att = attempts | attempts_d
+    add = adds | adds_d
+    fr = jnp.where(has_read_d, read_d, final_read)
+    lost = add & ~fr
+    unexpected = fr & ~att
+    valid_now = has_read & ~jnp.any(lost | unexpected)
+    return (att, add, fr, valid_now, jnp.sum(lost),
+            jnp.sum(unexpected))
+
+
+@functools.partial(jax.jit, static_argnames=("n_elems",))
+def wl_sets_delta(attempts, adds, final_read, attempts_d, adds_d,
+                  read_d, has_read_d, has_read, *, n_elems: int):
+    """Stream-rung solo advance: O(delta) dispatches — the carry is
+    the three (E,) membership planes at the session's ``WL_ELEMS``
+    rung (the ``wl-sets-delta`` ladder, PROGRAMS.md)."""
+    assert attempts.shape == (n_elems,)
+    return _sets_delta_body(attempts, adds, final_read, attempts_d,
+                            adds_d, read_d, has_read_d, has_read)
+
+
+@functools.partial(jax.jit, static_argnames=("n_elems",))
+def wl_sets_delta_mb(carries, attempts_d, adds_d, read_d, has_read_d,
+                     has_read, *, n_elems: int):
+    """Megabatched advance: ``carries`` is a TUPLE of per-lane
+    ``(attempts, adds, final_read)`` device triples (stacked INSIDE
+    the jit); delta planes arrive host-stacked with a lane axis.
+    Returns one output tuple per lane — same body as solo,
+    bit-identical per lane."""
+    att = jnp.stack([c[0] for c in carries])
+    add = jnp.stack([c[1] for c in carries])
+    fr = jnp.stack([c[2] for c in carries])
+    assert att.shape == (len(carries), n_elems)
+    outs = jax.vmap(_sets_delta_body)(att, add, fr, attempts_d,
+                                      adds_d, read_d, has_read_d,
+                                      has_read)
+    return tuple(tuple(o[i] for o in outs)
+                 for i in range(len(carries)))
+
+
+def sets_verdicts(cols: SetsColumns, out) -> List[dict]:
+    """Decode to the oracle's result shape — same interval-set strings
+    and fractions as :class:`~..checkers.SetChecker`, bit-identical on
+    every lane."""
+    from ...utils.intervals import fraction, integer_interval_set_str
+    from ..checkers import UNKNOWN
+
+    valid, ok, lost, unexpected, recovered = \
+        (np.asarray(x) for x in out)
+    verdicts = []
+    for b, table in enumerate(cols.tables):
+        if not cols.has_read[b]:
+            verdicts.append({"valid?": UNKNOWN,
+                             "error": "Set was never read"})
+            continue
+        dec = lambda plane: {table[i] for i in np.flatnonzero(plane[b])}
+        n_att = int(np.count_nonzero(cols.attempts[b]))
+        sets = {k: dec(p) for k, p in
+                (("ok", ok), ("lost", lost),
+                 ("unexpected", unexpected), ("recovered", recovered))}
+        v = {"valid?": bool(valid[b])}
+        for k, s in sets.items():
+            v[k] = integer_interval_set_str(s)
+            v[f"{k}-frac"] = fraction(len(s), n_att)
+        # match the oracle's key order/shape exactly
+        verdicts.append({"valid?": v["valid?"],
+                         "ok": v["ok"], "lost": v["lost"],
+                         "unexpected": v["unexpected"],
+                         "recovered": v["recovered"],
+                         "ok-frac": v["ok-frac"],
+                         "unexpected-frac": v["unexpected-frac"],
+                         "lost-frac": v["lost-frac"],
+                         "recovered-frac": v["recovered-frac"]})
+    return verdicts
+
+
+__all__ = ["SetsColumns", "encode_sets", "sets_verdicts",
+           "wl_sets_check", "wl_sets_delta", "wl_sets_delta_mb"]

@@ -12,8 +12,24 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from .checkers import Checker
+from .checkers import UNKNOWN, Checker
 from .independent import is_tuple
+
+
+def freeze_value(x: Any) -> Any:
+    """Coerce a (possibly nested) op value to a hashable form: lists
+    and tuples become tuples, sets frozensets, dicts sorted pair
+    tuples. Mirrors ``ops.history._plain`` for values that did NOT
+    arrive through the EDN reader — a driver handing the checker raw
+    lists must not crash the set membership test."""
+    if isinstance(x, (list, tuple)):
+        return tuple(freeze_value(e) for e in x)
+    if isinstance(x, (set, frozenset)):
+        return frozenset(freeze_value(e) for e in x)
+    if isinstance(x, dict):
+        return tuple(sorted(((freeze_value(k), freeze_value(v))
+                             for k, v in x.items()), key=repr))
+    return x
 
 
 class BankChecker(Checker):
@@ -45,19 +61,39 @@ class DirtyReadsChecker(Checker):
     """Looks for a failed write's value visible to some read; also
     reports reads whose per-node values disagree
     (``comdb2/core.clj:492-523``: read values are sequences of the row
-    as seen from each node)."""
+    as seen from each node).
+
+    This is the parity oracle for the device ``wl-dirty`` family
+    (``comdb2_tpu.checker.wl``), so it must be exact: values are
+    frozen to hashable tuples before set membership (a raw-list
+    payload used to raise ``TypeError`` out of the set build), and a
+    read whose value is a scalar or a ``str`` — which would silently
+    iterate per CHARACTER — is rejected with a ``malformed-reads``
+    cause instead of producing a wrong verdict."""
 
     def check(self, test, model, history, opts=None):
-        failed_writes = {op.value for op in history
+        failed_writes = {freeze_value(op.value) for op in history
                          if op.type == "fail" and op.f == "write"}
-        reads = [op.value for op in history
-                 if op.type == "ok" and op.f == "read"
-                 and op.value is not None]
+        reads = []
+        malformed = []
+        for i, op in enumerate(history):
+            if op.type != "ok" or op.f != "read" or op.value is None:
+                continue
+            if isinstance(op.value, (str, bytes)) \
+                    or not isinstance(op.value, (list, tuple)):
+                malformed.append(i if op.index is None else op.index)
+                continue
+            reads.append(tuple(freeze_value(x) for x in op.value))
         inconsistent = [v for v in reads if len(set(v)) > 1]
-        filthy = [v for v in reads if any(x in failed_writes for x in v)]
-        return {"valid?": not filthy,
-                "inconsistent-reads": inconsistent,
-                "dirty-reads": filthy}
+        filthy = [v for v in reads
+                  if any(x in failed_writes for x in v)]
+        out = {"valid?": not filthy,
+               "inconsistent-reads": inconsistent,
+               "dirty-reads": filthy}
+        if malformed:
+            out["valid?"] = UNKNOWN
+            out["malformed-reads"] = malformed
+        return out
 
 
 dirty_reads_checker = DirtyReadsChecker()

@@ -28,12 +28,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                    choices=sorted(MODELS),
                    help="consistency model (default cas-register)")
     p.add_argument("--checker", default="linear",
-                   choices=["linear", "set", "wgl", "txn"],
+                   choices=["linear", "set", "wgl", "txn",
+                            "bank", "sets", "dirty"],
                    help="linear (frontier search), wgl (world search), "
-                        "set semantics, or txn (serializability over "
-                        "list-append txn ops)")
+                        "set semantics, txn (serializability over "
+                        "list-append txn ops), or a workload family "
+                        "(bank/sets/dirty — the device column-plane "
+                        "checkers, docs/workloads.md; bank needs "
+                        "--wl-n/--wl-total)")
     p.add_argument("--txn", action="store_true",
                    help="shorthand for --checker txn")
+    p.add_argument("--wl-n", type=int, metavar="N",
+                   help="--checker bank: number of accounts")
+    p.add_argument("--wl-total", type=int, metavar="T",
+                   help="--checker bank: invariant balance total")
     p.add_argument("--realtime", action="store_true",
                    help="with --txn: include realtime edges (strict "
                         "serializability)")
@@ -82,6 +90,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = p.parse_args(argv)
     if args.txn:
         args.checker = "txn"
+    if args.checker == "bank" and (args.wl_n is None
+                                   or args.wl_total is None):
+        p.error("--checker bank needs --wl-n and --wl-total")
 
     if args.trace:
         from .obs import trace as obs_trace
@@ -99,6 +110,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             # leave the process as found (embedders run main() too)
             obs_trace.disable()
             obs_trace.clear()
+
+
+#: the workload families (docs/workloads.md) — mirrors
+#: checker.wl.batch.FAMILIES without importing jax at parse time
+_WL_FAMILIES = ("bank", "sets", "dirty")
+
+
+def _wl_model(args):
+    return ({"n": args.wl_n, "total": args.wl_total}
+            if args.checker == "bank" else None)
 
 
 def _run(args) -> int:
@@ -132,6 +153,10 @@ def _run(args) -> int:
                                             "txn" else args.model),
                                      keyed=args.keyed,
                                      raise_on_error=False)
+                elif args.checker in _WL_FAMILIES:
+                    reply = c.check_wl(text, args.checker,
+                                       wl=_wl_model(args),
+                                       raise_on_error=False)
                 elif args.checker == "txn":
                     reply = c.check(text, txn=True,
                                     realtime=args.realtime,
@@ -176,8 +201,8 @@ def _run(args) -> int:
             return 2
         return 1
 
-    if (args.checker in ("linear", "txn") and args.backend != "host") \
-            or args.shrink:
+    if (args.checker in ("linear", "txn") + _WL_FAMILIES
+            and args.backend != "host") or args.shrink:
         # only the device frontier search needs a JAX backend; the set
         # and wgl checkers (and host linear) are pure host Python, and
         # in the ambient env touching jax attaches the tunneled TPU.
@@ -194,16 +219,32 @@ def _run(args) -> int:
             history = parse_history(fh.read())
 
     if (args.keyed or args.model == "cas-register-comdb2") \
-            and args.checker != "txn":
+            and args.checker != "txn" \
+            and args.checker not in _WL_FAMILIES:
         # the comdb2 tuple model exists solely for keyed histories;
         # EDN [k v] vectors carry no type tag, so re-tag them here —
         # NEVER for txn histories: their values are micro-op vectors,
-        # not [k v] pairs, and wrapping would corrupt them
+        # not [k v] pairs, and wrapping would corrupt them. Workload
+        # families never wrap either: a bank read's [b0 b1] balance
+        # row would mis-parse as a cas pair
         from .checker.independent import wrap_keyed_history
 
         history = wrap_keyed_history(history)
 
-    if args.checker == "txn":
+    if args.checker in _WL_FAMILIES:
+        if args.backend == "host":
+            from .checker.wl.batch import _host_fallback
+
+            result = _host_fallback([history], args.checker,
+                                    _wl_model(args))[0]
+        else:
+            from .checker.wl import check_wl_batch
+
+            result = check_wl_batch([history], args.checker,
+                                    _wl_model(args))[0]
+        pprint.pprint(result)
+        valid = result.get("valid?")
+    elif args.checker == "txn":
         from .txn import check_txn
 
         result = check_txn(history, backend=args.backend,

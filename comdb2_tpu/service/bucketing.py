@@ -134,6 +134,58 @@ class StreamBucket(NamedTuple):
         return self.cls
 
 
+class WlBucket(NamedTuple):
+    """One workload-family compiled-shape class (``kind:"wl"``,
+    docs/workloads.md). ``sig`` holds the padded per-history axes as
+    ``(letter, rung)`` pairs — exactly what reaches the family jit —
+    and names the bucket in metrics. ``model_key`` pins the bank
+    model CONTENT (frozen ``{"n","total","init"}``): one dispatch
+    encodes the whole chunk against ONE model, so different-model
+    requests must land in different slots — but the model is data,
+    not shape, so it stays out of ``key`` (same program, same
+    metrics row)."""
+
+    family: str
+    sig: tuple = ()
+    model_key: tuple = ()
+
+    @property
+    def key(self) -> str:
+        return "wl-" + self.family + "".join(
+            f"-{a}{v}" for a, v in self.sig)
+
+
+#: sig-letter -> the encode kwarg it pins (per family; letters are
+#: unique within each family's dim set)
+_WL_DIM_KEYS = {"r": "r_pad", "a": "a_pad", "t": "t_pad",
+                "e": "e_pad", "n": "n_pad", "v": "v_pad"}
+
+
+def wl_dims_of(bucket: "WlBucket") -> dict:
+    """The encode kwargs a WlBucket pins (inverse of the sig)."""
+    return {_WL_DIM_KEYS[a]: v for a, v in bucket.sig}
+
+
+def wl_bucket_for(family: str, ops,
+                  model: Optional[dict] = None) -> Optional["WlBucket"]:
+    """The wl bucket one history lands in, or None when an axis
+    exceeds its family's top rung (host-oracle route — one big
+    history degrades alone, it never poisons a batch)."""
+    from ..checker.wl.batch import wl_dims
+
+    dims = wl_dims([ops], family, model)
+    if dims is None:
+        return None
+    sig = tuple((k[0], v) for k, v in dims.items())
+    mk = ()
+    if family == "bank":
+        from ..checker.workloads import freeze_value
+
+        mk = freeze_value({k: model[k] for k in
+                           ("n", "total", "init") if k in model})
+    return WlBucket(family=family, sig=sig, model_key=mk)
+
+
 class TxnBucket(NamedTuple):
     """One compiled-shape class of the txn closure engine: the only
     jit-visible axis is the padded txn count N (pow2, floor
@@ -160,4 +212,5 @@ def txn_bucket_for(n_txns: int,
 
 
 __all__ = ["Bucket", "ServiceLimits", "StreamBucket", "TxnBucket",
-           "bucket_for", "txn_bucket_for"]
+           "WlBucket", "bucket_for", "txn_bucket_for",
+           "wl_bucket_for", "wl_dims_of"]

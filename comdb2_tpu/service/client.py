@@ -196,6 +196,28 @@ class ServiceClient:
         reply = self._request_shedding(req)
         return _checked(reply, raise_on_error)
 
+    def check_wl(self, history: Union[str, List, None], family: str,
+                 *, wl: Optional[dict] = None,
+                 deadline_ms: Optional[int] = None,
+                 raise_on_error: bool = True) -> dict:
+        """Check one workload-family history (``kind:"wl"``,
+        docs/workloads.md): ``family`` is ``"bank"``/``"sets"``/
+        ``"dirty"``; bank takes ``wl={"n":..,"total":..}``. The reply
+        carries the host oracle's verdict fields (``bad-reads`` /
+        ``lost`` / ``dirty-reads`` ...) plus ``engine``/``bucket``
+        attribution — bit-identical to the in-process
+        ``check_wl_batch``."""
+        history = _as_edn(history)
+        self._seq += 1
+        req: dict = {"op": "check", "id": self._seq, "kind": "wl",
+                     "family": family, "history": history}
+        if wl is not None:
+            req["wl"] = wl
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+        reply = self._request_shedding(req)
+        return _checked(reply, raise_on_error)
+
     def shrink(self, history: Union[str, List, None] = None, *,
                model: Optional[str] = None, keyed: bool = False,
                txn: bool = False, realtime: bool = False,
@@ -230,6 +252,7 @@ class ServiceClient:
     def stream_open(self, *, model: Optional[str] = None,
                     keyed: bool = False, rung: Optional[str] = None,
                     checkpoint: Optional[dict] = None,
+                    wl: Optional[dict] = None,
                     raise_on_error: bool = True) -> dict:
         """Open a streaming session; the reply carries ``session``
         (the id every later verb names). An ``overload`` reply means
@@ -237,7 +260,9 @@ class ServiceClient:
         ``retry_after_ms`` like any other overload. ``checkpoint``
         (a wire checkpoint from :meth:`stream_checkpoint`) opens BY
         RESTORE — the migration handoff's receiving half; model/rung
-        ride inside the checkpoint and are ignored."""
+        ride inside the checkpoint and are ignored. ``wl`` carries
+        the workload-family params for the ``wl-bank``/``wl-sets``
+        session models (docs/workloads.md)."""
         self._seq += 1
         req: dict = {"op": "check", "id": self._seq,
                      "kind": "stream", "verb": "open"}
@@ -249,6 +274,8 @@ class ServiceClient:
             req["keyed"] = True
         if rung is not None:
             req["rung"] = rung
+        if wl is not None:
+            req["wl"] = wl
         reply = self._request_shedding(req)
         return _checked(reply, raise_on_error)
 
@@ -663,9 +690,20 @@ class RoutedClient:
                              route)
         return self._route(key, lambda c: c.shrink(history, **kw))
 
+    def check_wl(self, history: Union[str, List, None], family: str,
+                 *, route: str = "shape", **kw) -> dict:
+        """Route one workload-family check: the family IS the
+        client-visible shape class root, so one daemon owns each
+        family's bucket ladder and batch amortization survives
+        routing (docs/workloads.md)."""
+        history = _as_edn(history)
+        key = self.route_key(history, "wl", family, route)
+        return self._route(key,
+                           lambda c: c.check_wl(history, family, **kw))
+
     def stream_open(self, *, model: Optional[str] = None,
-                    keyed: bool = False,
-                    rung: Optional[str] = None) -> "RoutedStream":
+                    keyed: bool = False, rung: Optional[str] = None,
+                    wl: Optional[dict] = None) -> "RoutedStream":
         """Open a session with AFFINITY: the session id pins every
         later verb to the daemon holding the carry (routing an append
         elsewhere would find no session — a carry is not portable
@@ -674,7 +712,8 @@ class RoutedClient:
         next ring node and replays its retained deltas, then resumes
         — the client-side mirror of the daemon's retained columnar
         tables (docs/streaming.md "Failover")."""
-        return RoutedStream(self, model=model, keyed=keyed, rung=rung)
+        return RoutedStream(self, model=model, keyed=keyed, rung=rung,
+                            wl=wl)
 
     def statuses(self) -> Dict[str, dict]:
         """Per-daemon status (skipping unreachable nodes)."""
@@ -712,11 +751,13 @@ class RoutedStream:
 
     def __init__(self, routed: RoutedClient,
                  model: Optional[str] = None, keyed: bool = False,
-                 rung: Optional[str] = None):
+                 rung: Optional[str] = None,
+                 wl: Optional[dict] = None):
         self.routed = routed
         self.model = model
         self.keyed = keyed
         self.rung = rung
+        self.wl = wl
         self._deltas: List[str] = []
         self.failovers = 0
         self.migrations = 0
@@ -749,7 +790,7 @@ class RoutedStream:
                 continue
             try:
                 r = c.stream_open(model=self.model, keyed=self.keyed,
-                                  rung=self.rung,
+                                  rung=self.rung, wl=self.wl,
                                   checkpoint=checkpoint)
                 if self.node is not None:
                     self.routed._unpin(self.node)

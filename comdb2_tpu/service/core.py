@@ -50,7 +50,8 @@ from ..obs.metrics import Registry
 from ..utils import next_pow2 as _next_pow2
 from . import protocol
 from .bucketing import (Bucket, ServiceLimits, StreamBucket,
-                        TxnBucket, bucket_for, txn_bucket_for)
+                        TxnBucket, WlBucket, bucket_for,
+                        txn_bucket_for, wl_bucket_for, wl_dims_of)
 
 #: the per-request stage names (docs/observability.md): they TILE the
 #: measured wall per request — queue_wait (admission -> dispatch
@@ -289,6 +290,10 @@ class VerifierCore:
             # megabatched advances (round 13): fused programs that
             # carried >= 2 session lanes in one dispatch
             "stream_megabatches": 0,
+            # workload-family checks admitted (kind:"wl",
+            # docs/workloads.md) — they share accepted/completed/
+            # dispatches with every other kind
+            "wl_checks": 0,
         }
         self._g_sessions = self.metrics.gauge(
             "stream_sessions_active",
@@ -466,6 +471,8 @@ class VerifierCore:
             return self._submit_shrink(req, now, ctx, rid)
         if kind == "stream":
             return self._submit_stream(req, now, ctx, rid)
+        if kind == "wl":
+            return self._submit_wl(req, now, ctx, rid)
         if kind != "check":
             self.m["bad_requests"] += 1
             return None, protocol.error_reply(
@@ -608,6 +615,88 @@ class VerifierCore:
         else:
             self._hosts.append(pending)
         return pending, None
+
+    # -- wl-kind admission ---------------------------------------------
+
+    def _submit_wl(self, req: dict, now: float, ctx: object, rid):
+        """Admit one workload-family check (docs/workloads.md):
+        bank / sets / dirty-reads need no frontier search, so a
+        history is a handful of column planes and a whole bucket's
+        batch is ONE jit. From here the request rides the SAME
+        continuous-batching machinery as every kind — bucket slot,
+        launch policy, deadline expiry, in-flight ring; over-rung
+        histories degrade to the host oracle one at a time."""
+        from ..checker.wl import FAMILIES
+
+        family = req.get("family")
+        if family not in FAMILIES:
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"unknown wl family {family!r} (one of "
+                f"{'/'.join(FAMILIES)})", rid)
+        wlmodel = req.get("wl")
+        if family == "bank" and (
+                not isinstance(wlmodel, dict) or "n" not in wlmodel
+                or "total" not in wlmodel):
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                "bank needs wl: {'n':..,'total':..}", rid)
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, "missing history (EDN text)", rid)
+        try:
+            # never keyed-wrapped: wl values are balances/sets, and a
+            # bare [k v] read would mis-parse as a cas pair
+            from ..ops.native_loader import parse_history_fast
+
+            ops = parse_history_fast(text)
+        except Exception as e:              # noqa: BLE001 — client data
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST, f"unparseable history: {e}", rid)
+        dl = req.get("deadline_ms")
+        if dl is not None and not isinstance(dl, (int, float)):
+            self.m["bad_requests"] += 1
+            return None, protocol.error_reply(
+                protocol.BAD_REQUEST,
+                f"deadline_ms must be a number, got {type(dl).__name__}",
+                rid)
+        try:
+            bucket = wl_bucket_for(family, ops, wlmodel)
+        except (ValueError, TypeError) as e:
+            self.m["malformed"] += 1
+            return None, self._reply(rid, "unknown", kind="wl",
+                                     family=family,
+                                     cause=f"malformed: {e}")
+        self.m["accepted"] += 1
+        self.m["wl_checks"] += 1
+        pending = PendingRequest(
+            rid=rid, model=f"wl-{family}",
+            packed=(family, wlmodel, ops), bucket=bucket,
+            t_in=now, ctx=ctx, kind="wl",
+            t_dead=(now + float(dl) / 1e3) if dl is not None else None)
+        if bucket is not None:
+            self._bstats(bucket.key).requests += 1
+            self._slot_add(pending, now)
+        else:
+            self._hosts.append(pending)
+        return pending, None
+
+    def _wl_reply(self, rid, verdict: dict, family: str,
+                  **extra) -> dict:
+        """Compress one oracle-shaped wl verdict dict into a wire
+        reply (the family fields ride along verbatim — golden parity
+        means they are exactly the host checker's)."""
+        out = self._reply(rid, verdict.get("valid?"), kind="wl",
+                          family=family, **extra)
+        for k, v in verdict.items():
+            if k != "valid?":
+                out.setdefault(k, v)
+        return out
 
     # -- shrink-kind admission -----------------------------------------
 
@@ -764,17 +853,26 @@ class VerifierCore:
         if verb == "open":
             model = req.get("model") or self.model
             from ..models.model import MODELS
+            from ..stream.wl import WL_MODELS
 
-            if model not in MODELS:
+            is_wl = model in WL_MODELS
+            if not is_wl and model not in MODELS:
                 self.m["bad_requests"] += 1
                 return None, protocol.error_reply(
                     protocol.BAD_REQUEST, f"unknown model {model!r}",
                     rid)
             try:
-                sid, s = self.sessions.open(
-                    now, model=model,
-                    engine=req.get("rung", "auto"),
-                    max_states=self.max_host_configs)
+                if is_wl:
+                    # workload-family session (stream/wl.py): ``wl``
+                    # carries the family params (bank n/total); same
+                    # table, cap, eviction and checkpoint machinery
+                    sid, s = self.sessions.open(
+                        now, model=model, wl=req.get("wl"))
+                else:
+                    sid, s = self.sessions.open(
+                        now, model=model,
+                        engine=req.get("rung", "auto"),
+                        max_states=self.max_host_configs)
             except SessionLimit as e:
                 # a carry is device memory: the cap sheds exactly like
                 # the admission queue, hint included
@@ -785,8 +883,17 @@ class VerifierCore:
                     protocol.OVERLOAD, f"{e}; retry in ~{ra} ms", rid)
                 out["retry_after_ms"] = ra
                 return None, out
-            s.keyed = (bool(req.get("keyed"))
-                       or model == "cas-register-comdb2")
+            except (ValueError, TypeError) as e:
+                # bad wl params (bank without n/total): the client's
+                # bug, answered before any session exists
+                self.m["bad_requests"] += 1
+                return None, protocol.error_reply(
+                    protocol.BAD_REQUEST, f"bad wl params: {e}", rid)
+            if not is_wl:
+                # wl deltas are never keyed-wrapped (a bare [k v]
+                # read would mis-parse as a cas pair)
+                s.keyed = (bool(req.get("keyed"))
+                           or model == "cas-register-comdb2")
             self.m["stream_opens"] += 1
             return None, self._reply(rid, True, kind="stream",
                                      session=sid, model=model)
@@ -1110,6 +1217,8 @@ class VerifierCore:
             p = self._hosts.popleft()
             if p.kind == "txn":
                 self._host_check_txn(p, self._done)
+            elif p.kind == "wl":
+                self._host_check_wl(p, self._done)
             else:
                 self._host_check(p, self._done)
         self._step_shrinks()
@@ -1238,6 +1347,8 @@ class VerifierCore:
                 self._done)
             return
         extra = {"kind": "txn"} if p.kind == "txn" else {}
+        if p.kind == "wl":
+            extra = {"kind": "wl", "family": p.packed[0]}
         if p.kind == "stream":
             # the delta was never ingested: the session is unchanged
             # and the client may retry the same append
@@ -1266,6 +1377,8 @@ class VerifierCore:
                 fin = self._dispatch_txn_begin(bucket, chunk)
             elif kind == "stream":
                 fin = self._dispatch_stream_begin(bucket, chunk)
+            elif kind == "wl":
+                fin = self._dispatch_wl_begin(bucket, chunk)
             else:
                 fin = self._dispatch_begin(model, bucket, chunk)
             self._ring_push(fin)
@@ -1440,6 +1553,90 @@ class VerifierCore:
                                         cause=f"engine: {cause}",
                                         bucket=bucket.key), done)
 
+    def _dispatch_wl_begin(self, bucket: WlBucket,
+                           items: List[PendingRequest]):
+        """Stage one wl-family bucket chunk: encode the column planes
+        and launch ONE device program (``stage_wl_batch``'s finalize
+        is the readback point) — same ring contract as
+        :meth:`_dispatch_begin`. The bucket's sig pins the padded
+        per-history axes and its ``model_key`` pinned the slot, so
+        every item shares one encode model and one compiled
+        program; the batch axis pow2-pads inside the stage by
+        duplicating lane 0."""
+        from ..checker.wl import batch as WLB
+
+        t0 = obs.monotonic()
+        rids = [p.rid for p in items]
+        for p in items:
+            p.stages["queue_wait_ms"] = (t0 - p.t_in) * 1e3
+        family = bucket.family
+        wlmodel = items[0].packed[1]
+        hists = [p.packed[2] for p in items]
+        d0 = WLB.DISPATCHES
+        try:
+            with obs.span("stage", kind="wl", bucket=bucket.key,
+                          b=len(items), rids=rids):
+                fin0 = WLB.stage_wl_batch(hists, family, wlmodel,
+                                          dims=wl_dims_of(bucket))
+        except Exception as e:                  # noqa: BLE001
+            cause = f"{type(e).__name__}: {e}"
+            return lambda done: self._fail_batch(items, bucket, cause,
+                                                 done)
+        n_disp = WLB.DISPATCHES - d0
+        bp = WLB.bucket_of(len(items), WLB.WL_BATCH)
+        t_staged = obs.monotonic()
+        pack_ms = (t_staged - t0) * 1e3
+        for p in items:
+            p.stages["host_pack_ms"] = pack_ms
+
+        def finish(done: list) -> None:
+            t_fin = obs.monotonic()
+            try:
+                verdicts = fin0()
+            except Exception as e:              # noqa: BLE001
+                self._fail_batch(items, bucket,
+                                 f"{type(e).__name__}: {e}", done)
+                return
+            if n_disp:
+                self._sleep_remaining_tunnel(t_staged)
+            t_done = obs.monotonic()
+            # n_disp == 0 means the stage degraded the whole chunk to
+            # the host oracle (encode-time overflow) — the verdicts
+            # carry engine:"host" and no program accounting applies
+            eng = ("wl-device" if n_disp
+                   else verdicts[0].get("engine", "host"))
+            if not n_disp:
+                self.m["host_degraded"] += len(items)
+            self._account_dispatch(bucket.key, t_staged, t_done, eng,
+                                   {}, rids)
+            bs = self._bstats(bucket.key)
+            bs.dispatches += n_disp
+            bs.batched += len(items)
+            if n_disp:
+                bs.occupancy_sum += len(items) / bp
+                pk = ("wl", bucket.key, bp)
+                if pk in self._programs:
+                    self.m["program_hits"] += 1
+                else:
+                    self._programs.add(pk)
+                    bs.compiles += 1
+                    self.m["compiles"] += 1
+                bs.programs.add(pk)
+            bs.device_s += (t_staged - t0) + (t_done - t_fin)
+            self.m["dispatches"] += n_disp
+            with obs.span("finalize", kind="wl", bucket=bucket.key,
+                          rids=rids):
+                for p, v in zip(items, verdicts):
+                    p.stages["device_ms"] = (t_done - t_staged) * 1e3
+                    p.stages["finalize_ms"] = \
+                        (obs.monotonic() - t_done) * 1e3
+                    self._finish(p, self._wl_reply(
+                        p.rid, v, family,
+                        engine=v.get("engine", eng),
+                        bucket=bucket.key, batched=len(items)), done)
+
+        return finish
+
     def _dispatch_txn_begin(self, bucket: TxnBucket,
                             items: List[PendingRequest]):
         """Stage ONE device dispatch for a txn bucket's chunk (same
@@ -1581,6 +1778,28 @@ class VerifierCore:
                 engine="host", degraded=True)
         except Exception as e:                  # noqa: BLE001
             reply = self._reply(p.rid, "unknown",
+                                cause=f"host engine: {e}",
+                                engine="host", degraded=True)
+        p.stages["device_ms"] = (obs.monotonic() - t0) * 1e3
+        self._finish(p, reply, done)
+
+    def _host_check_wl(self, p: PendingRequest, done: list) -> None:
+        """Over-rung wl histories degrade to the demoted host oracle
+        (checker/workloads.py), one request at a time — same contract
+        as the linear/txn host routes."""
+        from ..checker.wl.batch import _host_fallback
+
+        self.m["host_degraded"] += 1
+        t0 = self._degrade_begin(p)
+        family, wlmodel, ops = p.packed
+        try:
+            with obs.span("host_degrade", kind="wl", rid=p.rid):
+                v = _host_fallback([ops], family, wlmodel)[0]
+            reply = self._wl_reply(p.rid, v, family, engine="host",
+                                   degraded=True)
+        except Exception as e:                  # noqa: BLE001
+            reply = self._reply(p.rid, "unknown", kind="wl",
+                                family=family,
                                 cause=f"host engine: {e}",
                                 engine="host", degraded=True)
         p.stages["device_ms"] = (obs.monotonic() - t0) * 1e3

@@ -511,6 +511,15 @@ class MegaBatch:
             _Lane(sess, eng, n, seg_offset, pack_fn=pack_fn,
                   table=table))
 
+    def add_wl(self, key: tuple, lane) -> None:
+        """Queue one workload-family session delta
+        (:mod:`comdb2_tpu.stream.wl`). ``key`` is the wl fuse key —
+        ``("wl-bank", a_pad)`` / ``("wl-sets", e_pad)``, pinning the
+        carry width the lanes must share — and ``lane`` the wl
+        module's staged-lane record (it exposes ``.sess`` so the
+        flush-failure latch covers wl lanes too)."""
+        self._groups.setdefault(key, []).append(lane)
+
     def flush(self) -> None:
         while self._groups:
             groups, self._groups = self._groups, {}
@@ -525,6 +534,10 @@ class MegaBatch:
     # -- launch forms --------------------------------------------------
 
     def _launch_group(self, key, lanes) -> None:
+        if isinstance(key[0], str) and key[0].startswith("wl-"):
+            from . import wl as _WL
+            _WL.launch_wl_group(self, key, lanes)
+            return
         top = MEGABATCH_LANES[-1]
         for i in range(0, len(lanes), top):
             chunk = lanes[i:i + top]

@@ -29,7 +29,17 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from ..obs import trace as _obs
+from . import wl as _wl
 from .session import StreamSession
+
+
+def _restore(ck: dict):
+    """Checkpoint router: wl-family checkpoints carry the
+    ``wl_family`` discriminator; everything else is a frontier
+    session's."""
+    if ck.get("wl_family"):
+        return _wl.restore_session(ck)
+    return StreamSession.restore(ck)
 
 
 class SessionLimit(Exception):
@@ -60,14 +70,19 @@ class SessionManager:
         return len(self._sessions)
 
     def open(self, now: float, model: str = "cas-register",
-             engine: str = "auto",
-             max_states: int = 1 << 20) -> Tuple[str, StreamSession]:
+             engine: str = "auto", max_states: int = 1 << 20,
+             wl: Optional[dict] = None) -> Tuple[str, StreamSession]:
         if len(self._sessions) >= self.max_sessions:
             raise SessionLimit(
                 f"session table at cap ({self.max_sessions})")
         sid = self._new_sid()
-        s = StreamSession(model=model, engine=engine,
-                          max_states=max_states)
+        if model in _wl.WL_MODELS:
+            # workload-family session (stream/wl.py): same table,
+            # caps, eviction and checkpoint discipline
+            s = _wl.make_session(model, wl)
+        else:
+            s = StreamSession(model=model, engine=engine,
+                              max_states=max_states)
         self._sessions[sid] = s
         self._touched[sid] = now
         self.opened += 1
@@ -82,7 +97,7 @@ class SessionManager:
         if len(self._sessions) >= self.max_sessions:
             raise SessionLimit(
                 f"session table at cap ({self.max_sessions})")
-        s = StreamSession.restore(ck)
+        s = _restore(ck)
         sid = self._new_sid()
         self._sessions[sid] = s
         self._touched[sid] = now
@@ -103,7 +118,7 @@ class SessionManager:
             # bouncing it would only trade a cheap upload for a full
             # client replay.
             ck = self._checkpoints.pop(sid)
-            s = StreamSession.restore(ck)
+            s = _restore(ck)
             self._sessions[sid] = s
             self.restores += 1
             if now is not None:

@@ -1,0 +1,234 @@
+#!/usr/bin/env python3
+"""Bench the device workload-checker families (ISSUE 20).
+
+Usage: PYTHONPATH=$AXON_SITE:. python scripts/bench_wl.py \
+           [--json BENCH_wl.json] [--quick]
+(real TPU; CPU works for smoke via JAX_PLATFORMS=cpu.)
+
+Three sections, one JSON line:
+
+- ``families``: per family (bank / sets / dirty), a batch-size sweep.
+  Every (family, B) cell HARD-ASSERTS verdict parity against the
+  demoted host oracle — valid batch and seeded-violation twin both —
+  before any timing counts. Timed: the ONE-dispatch device batch vs
+  the per-history host loop; the dispatch count is asserted on the
+  ``wl.batch.DISPATCHES`` delta (one per pow2 chunk).
+- ``amortization``: the serving-plane claim. A dispatch+readback
+  round-trip costs ~100 ms over the tunnel (CLAUDE.md), so verdicts
+  per round-trip IS the metric a naive per-history loop loses: B
+  histories dispatched one-by-one pay B round-trips where the batch
+  pays one. Modeled wall = measured compute + round_trip_ms * trips.
+- ``stream``: bank megabatch — N sessions advanced per beat, solo
+  (N programs) vs fused (1), with the same modeled round-trip.
+
+The run's compile-guard summary is embedded (observed lowerings ⊆
+PROGRAMS.md; COMDB2_TPU_COMPILE_GUARD=0 makes the assert report-only).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+#: tunnel dispatch+readback round-trip (measured, CLAUDE.md)
+ROUND_TRIP_MS = 100.0
+
+
+def _time(fn, reps=3):
+    out = fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def families_section(quick: bool) -> list:
+    from comdb2_tpu.checker import wl as W
+    from comdb2_tpu.checker.wl import batch as WLB
+    from comdb2_tpu.checker.wl.batch import _host_fallback
+
+    sizes = (8, 64) if quick else (8, 64, 512)
+    gens = {
+        "bank": lambda s, b, v: W.bank_batch(s, b, violation=v),
+        "sets": lambda s, b, v: (W.sets_batch(s, b, violation=v),
+                                 None),
+        "dirty": lambda s, b, v: (W.dirty_batch(s, b, violation=v),
+                                  None),
+    }
+    viols = {"bank": "total", "sets": "lost", "dirty": "dirty"}
+    rows = []
+    for family, gen in gens.items():
+        for B in sizes:
+            row = {"family": family, "B": B}
+            for key, viol in (("valid", None),
+                              ("violation", viols[family])):
+                hists, model = gen(1000 + B, B, viol)
+                n_ops = sum(len(h) for h in hists)
+
+                # parity gate BEFORE timing: device == oracle lane
+                # by lane on the verdict
+                dev = W.check_wl_batch(hists, family, model)
+                host = _host_fallback(hists, family, model)
+                for i, (d, h) in enumerate(zip(dev, host)):
+                    assert d["valid?"] == h["valid?"], \
+                        (family, B, key, i, d, h)
+                want = viol is None
+                assert all(d["valid?"] is want for d in dev), \
+                    (family, B, key)
+
+                d0 = WLB.DISPATCHES
+                dev_t, _ = _time(
+                    lambda: W.check_wl_batch(hists, family, model))
+                # one program per pow2 bucket, per timed rep (+1
+                # parity run above = reps + 1 warmup... the gate ran
+                # once, _time runs 1 + 3): counted at the entry
+                per_run = (WLB.DISPATCHES - d0) // 4
+                assert per_run == 1, (family, B, WLB.DISPATCHES - d0)
+                host_t, _ = _time(
+                    lambda: _host_fallback(hists, family, model))
+                row[key] = {
+                    "ops": n_ops,
+                    "device_batch_s": round(dev_t, 4),
+                    "host_loop_s": round(host_t, 4),
+                    "device_ops_per_s": round(n_ops / dev_t, 1),
+                    "host_ops_per_s": round(n_ops / host_t, 1),
+                }
+            rows.append(row)
+            print(f"{family:5s} B={B:3d} device "
+                  f"{row['valid']['device_ops_per_s']:10.0f} ops/s  "
+                  f"host {row['valid']['host_ops_per_s']:10.0f} ops/s",
+                  flush=True)
+    return rows
+
+
+def amortization_section(quick: bool) -> dict:
+    """B verdicts per tunnel round-trip: batch=1 trip, loop=B trips."""
+    from comdb2_tpu.checker import wl as W
+    from comdb2_tpu.checker.wl import batch as WLB
+
+    B = 16 if quick else 64
+    hists, model = W.bank_batch(77, B)
+
+    d0 = WLB.DISPATCHES
+    batch_t, out = _time(lambda: W.check_wl_batch(hists, "bank",
+                                                  model))
+    assert (WLB.DISPATCHES - d0) // 4 == 1
+    assert all(v["valid?"] is True for v in out)
+
+    d0 = WLB.DISPATCHES
+    loop_t, _ = _time(lambda: [
+        W.check_wl_batch([h], "bank", model) for h in hists])
+    assert (WLB.DISPATCHES - d0) // 4 == B, "loop pays B dispatches"
+
+    batch_wall = batch_t * 1e3 + ROUND_TRIP_MS
+    loop_wall = loop_t * 1e3 + ROUND_TRIP_MS * B
+    out = {
+        "B": B,
+        "round_trip_ms": ROUND_TRIP_MS,
+        "batch_compute_ms": round(batch_t * 1e3, 2),
+        "loop_compute_ms": round(loop_t * 1e3, 2),
+        "batch_modeled_wall_ms": round(batch_wall, 1),
+        "loop_modeled_wall_ms": round(loop_wall, 1),
+        "modeled_speedup": round(loop_wall / batch_wall, 1),
+    }
+    print(f"amortization B={B}: modeled wall {loop_wall:.0f} ms "
+          f"(loop) -> {batch_wall:.0f} ms (batch), "
+          f"{out['modeled_speedup']}x", flush=True)
+    return out
+
+
+def stream_section(quick: bool) -> dict:
+    """Megabatched session advance: N beats per round-trip."""
+    import numpy as np
+
+    from comdb2_tpu.checker import wl as W
+    from comdb2_tpu.stream import engine as SE
+    from comdb2_tpu.stream import wl as SW
+
+    N = 4 if quick else 8
+    hists, model = W.bank_batch(88, N)
+
+    def solo():
+        sess = [SW.make_session("wl-bank", model) for _ in range(N)]
+        for s, h in zip(sess, hists):
+            s.append(h)
+        return sess
+
+    def fused():
+        sess = [SW.make_session("wl-bank", model) for _ in range(N)]
+        coll = SE.MegaBatch()
+        fins = [s.append_stage(h, collector=coll)
+                for s, h in zip(sess, hists)]
+        coll.flush()
+        [f() for f in fins]
+        return sess
+
+    d0 = SE.DISPATCHES
+    solo_t, solo_sess = _time(solo)
+    solo_d = (SE.DISPATCHES - d0) // 4
+    d0 = SE.DISPATCHES
+    fused_t, fused_sess = _time(fused)
+    fused_d = (SE.DISPATCHES - d0) // 4
+    assert solo_d == N and fused_d == 1, (solo_d, fused_d)
+    # bit parity: fused carries == solo carries
+    for a, b in zip(solo_sess, fused_sess):
+        assert np.array_equal(np.asarray(a._balance),
+                              np.asarray(b._balance))
+        a.close()
+        b.close()
+
+    solo_wall = solo_t * 1e3 + ROUND_TRIP_MS * N
+    fused_wall = fused_t * 1e3 + ROUND_TRIP_MS
+    out = {
+        "sessions": N,
+        "solo_dispatches": solo_d,
+        "fused_dispatches": fused_d,
+        "solo_modeled_wall_ms": round(solo_wall, 1),
+        "fused_modeled_wall_ms": round(fused_wall, 1),
+        "modeled_speedup": round(solo_wall / fused_wall, 1),
+    }
+    print(f"stream N={N}: {solo_d} solo dispatches -> {fused_d} "
+          f"fused, modeled {out['modeled_speedup']}x", flush=True)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="BENCH_wl.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="small sweep (CPU smoke)")
+    args = ap.parse_args()
+
+    from comdb2_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    from comdb2_tpu.analysis.compile_surface import static_inventory
+    from comdb2_tpu.utils import compile_guard
+
+    inv = static_inventory()
+    with compile_guard.guard() as g:
+        fam = families_section(args.quick)
+        amort = amortization_section(args.quick)
+        stream = stream_section(args.quick)
+    out = {
+        "backend": jax.default_backend(),
+        "quick": bool(args.quick),
+        "families": fam,
+        "amortization": amort,
+        "stream": stream,
+        "compile_guard": g.summary(inv),
+    }
+    with open(args.json, "w") as fh:
+        fh.write(json.dumps(out) + "\n")
+    print("artifact written:", args.json, flush=True)
+    if compile_guard.enabled():
+        g.assert_closed(inv)
+
+
+if __name__ == "__main__":
+    main()

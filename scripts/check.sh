@@ -284,6 +284,100 @@ if [ "$RC_MIN" -ne 1 ]; then
 fi
 rm -rf "$SHRINK_STORE"
 
+stage wl "workload-family checkers smoke (bank/sets/dirty)"
+# ISSUE-20 gate, three layers: (1) the checked-in EDN fixtures
+# through the filetest CLI — every seeded violation must be caught
+# (exit 1) and every clean twin must pass (exit 0), so the detector
+# can't cheat in either direction; (2) bench_wl --quick, which
+# hard-asserts device/oracle verdict parity per (family, B) cell and
+# one dispatch per pow2 bucket before timing, and closes the compile
+# guard over every wl program; (3) a daemon kind:"wl" round trip.
+WL_FIX=tests/fixtures/wl
+WL_BANK_ARGS="--checker bank --wl-n 8 --wl-total 160"
+set +e
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest $WL_BANK_ARGS \
+    "$WL_FIX/bank_valid.edn" >/dev/null
+RC_BV=$?
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest $WL_BANK_ARGS \
+    "$WL_FIX/bank_wrong_total.edn" >/dev/null
+RC_BB=$?
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --checker sets \
+    "$WL_FIX/sets_valid.edn" >/dev/null
+RC_SV=$?
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --checker sets \
+    "$WL_FIX/sets_lost.edn" >/dev/null
+RC_SB=$?
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --checker dirty \
+    "$WL_FIX/dirty_valid.edn" >/dev/null
+RC_DV=$?
+JAX_PLATFORMS=cpu python -m comdb2_tpu.filetest --checker dirty \
+    "$WL_FIX/dirty_dirty.edn" >/dev/null
+RC_DB=$?
+set -e
+if [ "$RC_BV$RC_SV$RC_DV" != "000" ]; then
+    echo "wl clean fixture flagged (bank=$RC_BV sets=$RC_SV" \
+         "dirty=$RC_DV)" >&2
+    exit 1
+fi
+if [ "$RC_BB$RC_SB$RC_DB" != "111" ]; then
+    echo "wl seeded violation MISSED (bank=$RC_BB sets=$RC_SB" \
+         "dirty=$RC_DB)" >&2
+    exit 1
+fi
+run env JAX_PLATFORMS=cpu python scripts/bench_wl.py --quick \
+    --json /tmp/bench_wl_smoke.json
+
+# daemon round trip: kind:"wl" rides the same continuous batching
+ZOMBIES_BEFORE=$(zombie_count)
+WL_LOG=$(mktemp)
+JAX_PLATFORMS=cpu python -m comdb2_tpu.service --port 0 \
+    --backend cpu --no-prime --frontier 64 >"$WL_LOG" 2>&1 &
+WL_PID=$!
+CLEANUP_PIDS="$WL_PID"
+for _ in $(seq 200); do
+    grep -q '"ready"' "$WL_LOG" 2>/dev/null && break
+    sleep 0.1
+done
+grep -q '"ready"' "$WL_LOG" || { echo "wl daemon never ready" >&2; \
+    cat "$WL_LOG" >&2; exit 1; }
+WL_LOG="$WL_LOG" python - <<'EOF'
+import json, os
+from comdb2_tpu.checker import wl as W
+from comdb2_tpu.ops.history import history_to_edn
+from comdb2_tpu.service.client import ServiceClient
+
+port = None
+with open(os.environ["WL_LOG"]) as fh:
+    for line in fh:
+        if '"ready"' in line:
+            port = json.loads(line)["port"]
+            break
+assert port is not None, "no ready line in daemon log"
+c = ServiceClient("127.0.0.1", port, timeout_s=300.0, retries=5,
+                  backoff_s=0.5)
+good, model = W.bank_batch(61, 1)
+bad, _ = W.bank_batch(61, 1, violation="total")
+r = c.check_wl(history_to_edn(list(good[0])), "bank", wl=model)
+assert r["ok"] and r["valid"] is True, r
+assert r["kind"] == "wl" and r["family"] == "bank", r
+r = c.check_wl(history_to_edn(list(bad[0])), "bank", wl=model)
+assert r["ok"] and r["valid"] is False and r["bad-reads"], r
+assert r["engine"] == "wl-device", r
+assert c.shutdown()
+EOF
+wait "$WL_PID"
+CLEANUP_PIDS=""
+rm -f "$WL_LOG"
+if pgrep -f "comdb2_tpu\.service" >/dev/null 2>&1; then
+    echo "wl daemon left a process behind" >&2
+    exit 1
+fi
+if ! ZOMBIES_AFTER=$(zombies_settled "$ZOMBIES_BEFORE"); then
+    echo "wl daemon left a zombie" \
+         "($ZOMBIES_BEFORE -> $ZOMBIES_AFTER)" >&2
+    exit 1
+fi
+
 stage mxu-smoke "MXU frontier engine smoke (wide-P valid + violation)"
 # the round-10 engine end to end through the driver ladder: a
 # genuinely concurrent P=16 bounded-in-flight history must come back
@@ -655,6 +749,8 @@ if [ "$JSON_MODE" = 0 ]; then
          "analysis clean, ct_pmux shutdown clean under ASan and TSan" \
          "(8 concurrent clients), txn smoke caught" \
          "the seeded cycle, shrink smoke reached the known minimum," \
+         "wl smoke caught every seeded family violation with" \
+         "device/oracle parity and a clean daemon round trip," \
          "mxu smoke answered both wide-P fixtures," \
          "multichip dryrun bit-identical across the mesh," \
          "verifier service shutdown clean, two-daemon pmux routing" \

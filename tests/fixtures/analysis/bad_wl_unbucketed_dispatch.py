@@ -1,0 +1,36 @@
+"""Seeded violation: raw (unbucketed) shapes reach the workload-family
+jit boundary — the ``wl_bank_check``/``wl_dirty_check`` dispatch sinks
+of the ``unbucketed-dispatch-site`` rule. The raw ``len(...)`` count is
+laundered through a helper so only the interprocedural chase can tie
+the call site to the family entry's static shape argument; one
+compiled program per distinct history shape, recompiles can OOM LLVM.
+"""
+
+from comdb2_tpu.checker.wl import bank as WB
+from comdb2_tpu.checker.wl import dirty as WD
+
+
+def _dispatch_bank(cols, n_reads, n_accounts):
+    # the sink: the bank entry's static dims come from the caller's
+    # parameters
+    return WB.wl_bank_check(
+        cols.reads, cols.read_mask, cols.wrong_n, cols.init,
+        cols.transfers, cols.total, n_reads=n_reads,
+        n_accounts=n_accounts, n_snaps=8)
+
+
+def check_all(batches):
+    out = []
+    for cols, reads in batches:
+        # BUG: raw per-batch counts, no bucket_of — every distinct
+        # history shape compiles a fresh program
+        out.append(_dispatch_bank(cols, len(reads), len(cols.init)))
+    return out
+
+
+def check_dirty(cols, values):
+    # BUG: the dirty value-universe width straight off the interning
+    # table — one program per distinct alphabet
+    return WD.wl_dirty_check(cols.failed, cols.reads, cols.node_mask,
+                             cols.read_mask, n_reads=8, n_nodes=4,
+                             n_values=len(values))

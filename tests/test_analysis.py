@@ -46,6 +46,7 @@ FIXTURE_RULES = {
     "bad_mxu_unbucketed_dispatch.py": "unbucketed-dispatch-site",
     "bad_stream_unbucketed_delta.py": "unbucketed-dispatch-site",
     "bad_stream_megabatch_delta.py": "unbucketed-dispatch-site",
+    "bad_wl_unbucketed_dispatch.py": "unbucketed-dispatch-site",
     "bad_stream_jnp_checkpoint.py": "host-numpy-checkpoint",
     "bad_unsharded_mesh_dispatch.py": "unbucketed-dispatch-site",
     "bad_vmap_sharded_route.py": "vmap-sharded-oracle",

@@ -421,6 +421,95 @@ def test_dirty_reads_dirty_commit_control_detected():
         _kill(procs)
 
 
+def _dirty_interleave(conn, base=10_000, n=3):
+    """The deterministic -R interleaving: W1 and W2 conflict, the
+    second commit's reported verdict + the rows a follow-up read sees
+    are returned (the dirty-commit lie shows up as ('fail', the
+    LOSER's values))."""
+    init = ClusterTxn(conn)
+    init.begin()
+    for i in range(n):
+        init.write(base + i, -1)
+    assert init.commit() == "ok"
+    t1 = ClusterTxn(conn)
+    t1.begin()
+    t2 = ClusterTxn(conn)
+    t2.begin()
+    for i in range(n):
+        t1.read(base + i)
+        t2.read(base + i)
+    for i in range(n):
+        t1.write(base + i, 7)
+        t2.write(base + i, 8)
+    assert t1.commit() == "ok"
+    second = t2.commit()
+    rd = ClusterTxn(conn)
+    rd.begin()
+    seen = tuple(rd.read(base + i) for i in range(n))
+    rd.commit()
+    return second, seen
+
+
+def _dirty_wl_history(second, seen):
+    return [
+        Op(process=0, type="invoke", f="write", value=7, time=0),
+        Op(process=0, type="ok", f="write", value=7, time=1),
+        Op(process=1, type="invoke", f="write", value=8, time=2),
+        Op(process=1, type=("ok" if second == "ok" else "fail"),
+           f="write", value=8, time=3),
+        Op(process=2, type="invoke", f="read", value=None, time=4),
+        Op(process=2, type="ok", f="read", value=seen, time=5),
+    ]
+
+
+def test_dirty_commit_through_wl_family_end_to_end():
+    """ISSUE-20 satellite: the cluster's -R dirty-commit control
+    detected by the DEVICE dirty-reads family (kind the service
+    serves), not just the host oracle — and the healthy cluster's
+    twin run checks VALID through the same path. Device and oracle
+    must bit-agree on both."""
+    from comdb2_tpu.checker.wl import check_wl_batch
+    from comdb2_tpu.checker.workloads import dirty_reads_checker
+
+    # -R cluster: the conflicted write reports FAIL but applies
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800,
+                          flags=["-R"])
+    conn = _conn(ports[0])
+    try:
+        second, seen = _dirty_interleave(conn)
+        assert second == "fail" and seen == (8, 8, 8), (second, seen)
+        history = _dirty_wl_history(second, seen)
+        dev = check_wl_batch([history], "dirty")[0]
+        host = dirty_reads_checker.check(None, None, history)
+        assert dev["valid?"] is False, dev
+        assert dev["dirty-reads"], dev
+        assert dev["valid?"] == host["valid?"]
+        assert sorted(dev["dirty-reads"]) == \
+            sorted(tuple(r) for r in host["dirty-reads"])
+    finally:
+        conn.close()
+        _kill(procs)
+
+    # healthy twin: OCC really aborts the loser — same probe, VALID
+    ports = _free_ports(3)
+    procs = spawn_cluster(BINARY, ports, durable=True, timeout_ms=800)
+    conn = _conn(ports[0])
+    try:
+        second, seen = _dirty_interleave(conn, base=20_000)
+        assert second == "fail" and seen == (7, 7, 7), (second, seen)
+        history = _dirty_wl_history(second, seen)
+        dev = check_wl_batch([history], "dirty")[0]
+        host = dirty_reads_checker.check(None, None, history)
+        assert dev["valid?"] is True, dev
+        assert host["valid?"] is True, host
+        assert dev["dirty-reads"] == [] \
+            and dev["inconsistent-reads"] == []
+    finally:
+        conn.close()
+        _kill(procs)
+
+
 # --- counter over the cluster (round-3 VERDICT #5) --------------------------
 
 def _counter_add(test=None, process=None):

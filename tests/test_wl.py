@@ -1,0 +1,244 @@
+"""Device workload-checker families (ISSUE 20): post-hoc surface.
+
+Golden twins per family (device verdict bit-agrees with the demoted
+host oracle on the seeded-violation generators), one dispatch per pow2
+bucket, the over-ladder host route, the DirtyReadsChecker robustness
+regressions, the filetest CLI over the checked-in EDN fixtures, and a
+compile-guard closure over every wl program the suite launches.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.checker import wl as W
+from comdb2_tpu.checker.wl import batch as WLB
+from comdb2_tpu.checker.wl.batch import _host_fallback
+from comdb2_tpu.ops.op import Op, invoke, ok
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "wl")
+
+
+# --- golden twins: device == oracle on every seeded generator ---------------
+
+def test_bank_golden_twins():
+    for viol in (None, "total", "n"):
+        hists, model = W.bank_batch(7, 3, violation=viol)
+        dev = W.check_wl_batch(hists, "bank", model)
+        host = _host_fallback(hists, "bank", model)
+        for d, h, hist in zip(dev, host, hists):
+            assert d["valid?"] == h["valid?"], (viol, d, h)
+            # same bad reads: the device cites the op INDEX where the
+            # oracle embeds the Op itself
+            assert len(d["bad-reads"]) == len(h["bad-reads"])
+            for db, hb in zip(d["bad-reads"], h["bad-reads"]):
+                assert db["type"] == hb["type"]
+                assert db["expected"] == hb["expected"]
+                assert db["found"] == hb["found"]
+                assert hist[db["index"]].value == hb["op"].value
+            if viol is not None:
+                assert d["valid?"] is False
+
+
+def test_bank_snapshot_plane_is_diagnostic_only():
+    """A fractured-but-balancing read trips the snapshot plane without
+    flipping valid? — the oracle has no such plane, so parity demands
+    it stays diagnostic."""
+    hists, model = W.bank_batch(9, 2, violation="snapshot")
+    dev = W.check_wl_batch(hists, "bank", model)
+    host = _host_fallback(hists, "bank", model)
+    for d, h in zip(dev, host):
+        assert d["valid?"] is True and h["valid?"] is True, (d, h)
+        assert d["snapshot-inconsistent"], d
+
+
+def test_sets_golden_twins():
+    for viol in (None, "lost", "phantom"):
+        hists = W.sets_batch(7, 3, violation=viol)
+        dev = W.check_wl_batch(hists, "sets")
+        host = _host_fallback(hists, "sets", None)
+        for d, h in zip(dev, host):
+            assert d["valid?"] == h["valid?"], (viol, d, h)
+            # interval-set strings + fractions, bit-identical
+            for key in ("ok", "lost", "unexpected", "recovered"):
+                assert d[key] == h[key], (viol, key, d, h)
+                assert d[f"{key}-frac"] == h[f"{key}-frac"]
+            if viol is not None:
+                assert d["valid?"] is False
+                key = "lost" if viol == "lost" else "unexpected"
+                assert d[key] != "#{}", (viol, d)
+
+
+def test_dirty_golden_twins():
+    from comdb2_tpu.checker.checkers import UNKNOWN
+
+    for viol in (None, "dirty", "disagree", "malformed"):
+        hists = W.dirty_batch(7, 3, violation=viol)
+        dev = W.check_wl_batch(hists, "dirty")
+        host = _host_fallback(hists, "dirty", None)
+        for d, h in zip(dev, host):
+            assert d["valid?"] == h["valid?"], (viol, d, h)
+            assert sorted(d["dirty-reads"]) == \
+                sorted(tuple(r) for r in h["dirty-reads"])
+            assert sorted(d["inconsistent-reads"]) == \
+                sorted(tuple(r) for r in h["inconsistent-reads"])
+            if viol == "dirty":
+                assert d["valid?"] is False and d["dirty-reads"]
+            if viol == "disagree":
+                # per-node disagreement is diagnostic, not a failure
+                assert d["inconsistent-reads"]
+            if viol == "malformed":
+                assert d["valid?"] is UNKNOWN
+                assert d["malformed-reads"] == h["malformed-reads"]
+
+
+# --- DirtyReadsChecker robustness regressions (satellite 1) -----------------
+
+def test_dirty_oracle_list_payload_no_typeerror():
+    """A raw-list read payload (unhashable) used to raise TypeError out
+    of the oracle's set build; both engines must now verdict it."""
+    hist = [invoke(0, "write", [1, 2]), Op(process=0, type="fail",
+                                           f="write", value=[1, 2]),
+            ok(1, "read", [[1, 2], [1, 2]])]
+    dev = W.check_wl_batch([hist], "dirty")[0]
+    host = _host_fallback([hist], "dirty", None)[0]
+    assert dev["valid?"] is False and host["valid?"] is False
+    assert dev["dirty-reads"] == [tuple(map(tuple, [[1, 2], [1, 2]]))]
+    assert dev["dirty-reads"] == \
+        [tuple(r) for r in host["dirty-reads"]]
+
+
+@pytest.mark.parametrize("payload", ["abc", 7])
+def test_dirty_oracle_scalar_and_str_reads_are_malformed(payload):
+    """A str read would silently iterate per CHARACTER, a scalar not at
+    all — both must answer UNKNOWN with the op index, not a verdict."""
+    from comdb2_tpu.checker.checkers import UNKNOWN
+
+    hist = [invoke(0, "write", 1), ok(0, "write", 1),
+            ok(1, "read", payload)]
+    dev = W.check_wl_batch([hist], "dirty")[0]
+    host = _host_fallback([hist], "dirty", None)[0]
+    assert dev["valid?"] is UNKNOWN and host["valid?"] is UNKNOWN
+    assert dev["malformed-reads"] == host["malformed-reads"] == [2]
+
+
+# --- dispatch accounting ----------------------------------------------------
+
+def test_one_dispatch_per_bucket():
+    hists, model = W.bank_batch(19, 6)
+    d0 = WLB.DISPATCHES
+    out = W.check_wl_batch(hists, "bank", model)
+    assert WLB.DISPATCHES - d0 == 1, "6 lanes must share one program"
+    assert len(out) == 6 and all(v["valid?"] is True for v in out)
+
+    hists = W.sets_batch(19, 9)
+    d0 = WLB.DISPATCHES
+    out = W.check_wl_batch(hists, "sets")
+    assert WLB.DISPATCHES - d0 == 1, "9 lanes bucket to B=64, one program"
+    assert len(out) == 9
+
+
+def test_over_top_batch_must_chunk():
+    with pytest.raises(ValueError, match="chunk first"):
+        W.stage_wl_batch([[]] * (WLB.WL_BATCH[-1] + 1), "sets")
+
+
+def test_host_route_past_ladder():
+    """> WL_NODES top node views: the pre-scan returns no dims and the
+    finalize routes through the host oracle (same verdict, engine
+    attribution)."""
+    hist = [invoke(0, "write", 1), ok(0, "write", 1),
+            ok(1, "read", tuple([1] * (WLB.WL_NODES[-1] + 4)))]
+    assert WLB.wl_dims([hist], "dirty") is None
+    d0 = WLB.DISPATCHES
+    out = W.check_wl_batch([hist], "dirty")[0]
+    assert WLB.DISPATCHES == d0, "host route must not dispatch"
+    assert out["engine"] == "host" and out["valid?"] is True, out
+
+
+def test_bad_args():
+    with pytest.raises(ValueError, match="unknown wl family"):
+        W.check_wl_batch([[]], "nope")
+    with pytest.raises(ValueError, match="bank needs"):
+        W.check_wl_batch([[]], "bank")
+
+
+# --- filetest over the checked-in EDN fixtures ------------------------------
+
+def test_filetest_wl_fixtures():
+    from comdb2_tpu import filetest
+
+    bank = ["--checker", "bank", "--wl-n", "8", "--wl-total", "160"]
+    cases = [("bank_valid.edn", bank, 0),
+             ("bank_wrong_total.edn", bank, 1),
+             ("sets_valid.edn", ["--checker", "sets"], 0),
+             ("sets_lost.edn", ["--checker", "sets"], 1),
+             ("dirty_valid.edn", ["--checker", "dirty"], 0),
+             ("dirty_dirty.edn", ["--checker", "dirty"], 1)]
+    for name, argv, want in cases:
+        path = os.path.join(FIXDIR, name)
+        assert filetest.main([path] + argv) == want, name
+    # --backend host runs the oracle, same exit codes
+    assert filetest.main(
+        [os.path.join(FIXDIR, "bank_wrong_total.edn"),
+         "--backend", "host"] + bank) == 1
+    assert filetest.main(
+        [os.path.join(FIXDIR, "dirty_dirty.edn"), "--backend", "host",
+         "--checker", "dirty"]) == 1
+
+
+# --- compile guard closes over the wl programs ------------------------------
+
+def test_wl_programs_in_inventory():
+    """Every program this subsystem launches — the three post-hoc
+    families plus bank/sets stream solo and fused advances — lowers to
+    a PROGRAMS.md-inventoried shape."""
+    from comdb2_tpu.stream import wl as SWL
+    from comdb2_tpu.stream.engine import MegaBatch
+    from comdb2_tpu.utils import compile_guard
+
+    with compile_guard.guard() as g:
+        hists, m = W.bank_batch(3, 6)
+        W.check_wl_batch(hists, "bank", m)
+        W.check_wl_batch(W.sets_batch(3, 6), "sets")
+        W.check_wl_batch(W.dirty_batch(3, 6), "dirty")
+        s1, s2 = (SWL.make_session("wl-bank", m) for _ in range(2))
+        mb = MegaBatch()
+        fins = [s.append_stage(list(h), collector=mb)
+                for s, h in zip((s1, s2), hists)]
+        mb.flush()
+        [f() for f in fins]
+        s1.append(list(hists[2]))                        # solo
+        t1, t2 = (SWL.make_session("wl-sets") for _ in range(2))
+        sh = W.sets_batch(5, 3)
+        mb2 = MegaBatch()
+        fins = [t.append_stage(list(h), collector=mb2)
+                for t, h in zip((t1, t2), sh)]
+        mb2.flush()
+        [f() for f in fins]
+        t1.append(list(sh[2]))                           # solo
+    offenders = g.offenders()
+    assert not offenders, \
+        [f"{r.name}: {r.shapes}" for r in offenders]
+
+
+# --- batch verdict structure ------------------------------------------------
+
+def test_bank_verdict_shape():
+    hists, model = W.bank_batch(23, 1, violation="total")
+    v = W.check_wl_batch(hists, "bank", model)[0]
+    assert v["valid?"] is False
+    assert v["first-bad-read"] >= 0
+    # the flagged op really disagrees with the model total
+    bad = v["bad-reads"][0]
+    assert bad["type"] == "wrong-total"
+    assert sum(hists[0][bad["index"]].value) == bad["found"]
+    assert bad["found"] != int(model["total"])
+
+
+def test_sets_verdict_shape():
+    hists = W.sets_batch(23, 1, violation="phantom")
+    v = W.check_wl_batch(hists, "sets")[0]
+    assert v["valid?"] is False and v["unexpected"] != "#{}", v
+    assert v["lost"] == "#{}", v

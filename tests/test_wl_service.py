@@ -1,0 +1,231 @@
+"""kind:"wl" through the serving plane (ISSUE 20).
+
+The wl families ride the continuous-batching core unchanged: verdict
+parity with ``check_wl_batch`` per family and violation twin, one
+dispatch per pow2 bucket (family+shape+model slotting), program-hit
+accounting, the host-degrade route, bad-request replies, wl stream
+sessions fusing same-beat appends into one program, the checkpoint
+verb's migration round-trip, and deadline expiries carrying the wl
+kind/family with stages tiling latency.
+"""
+
+import time
+
+from comdb2_tpu.checker import wl as W
+from comdb2_tpu.checker.wl import batch as WLB
+from comdb2_tpu.obs import trace as obs
+from comdb2_tpu.ops.history import history_to_edn
+from comdb2_tpu.ops.op import invoke, ok
+from comdb2_tpu.service.core import VerifierCore
+from comdb2_tpu.stream import engine as SE
+
+
+def test_wl_kind_parity_all_families():
+    core = VerifierCore(batch_cap=8)
+    rid = 0
+    cases = (("bank", lambda v: W.bank_batch(7, 3, violation=v),
+              (None, "total", "n")),
+             ("sets", lambda v: (W.sets_batch(7, 3, violation=v),
+                                 None),
+              (None, "lost", "phantom")),
+             ("dirty", lambda v: (W.dirty_batch(7, 3, violation=v),
+                                  None),
+              (None, "dirty", "disagree", "malformed")))
+    for family, gen, viols in cases:
+        for viol in viols:
+            hists, m = gen(viol)
+            oracle = W.check_wl_batch(hists, family, m)
+            pend = []
+            for h in hists:
+                rid += 1
+                p, r = core.submit(
+                    {"kind": "wl", "family": family, "id": rid,
+                     "history": history_to_edn(list(h)),
+                     **({"wl": m} if m else {})}, obs.monotonic())
+                assert r is None, r
+                pend.append(p)
+            done = {pp.rid: rep for pp, rep in core.tick()}
+            for p, o in zip(pend, oracle):
+                rep = done[p.rid]
+                assert rep["ok"] and rep["kind"] == "wl", rep
+                assert rep["valid"] == o["valid?"], \
+                    (family, viol, rep, o)
+                assert rep["family"] == family
+                # stages tile the measured wall (expiries included)
+                assert abs(sum(rep["stages"].values())
+                           - rep["latency_ms"]) < 1.0, rep
+
+
+def test_wl_batching_one_dispatch_and_program_hits():
+    core = VerifierCore(batch_cap=8)
+    hists, m = W.bank_batch(19, 6)
+    d0, svc0 = WLB.DISPATCHES, core.m["dispatches"]
+    for i, h in enumerate(hists):
+        p, r = core.submit({"kind": "wl", "family": "bank",
+                            "id": i + 1, "wl": m,
+                            "history": history_to_edn(list(h))},
+                           obs.monotonic())
+        assert r is None
+    done = core.tick()
+    assert len(done) == 6
+    assert WLB.DISPATCHES - d0 == 1, "6 requests must share one program"
+    assert core.m["dispatches"] - svc0 == 1
+    for _p, rep in done:
+        assert rep["valid"] is True and rep["batched"] == 6, rep
+        assert rep["engine"] == "wl-device"
+        assert rep["bucket"].startswith("wl-bank-"), rep
+
+    # same bucket again is a program hit, not a new program
+    hists2, _ = W.bank_batch(23, 3)
+    hits0 = core.m["program_hits"]
+    for i, h in enumerate(hists2):
+        core.submit({"kind": "wl", "family": "bank", "id": 100 + i,
+                     "wl": m, "history": history_to_edn(list(h))},
+                    obs.monotonic())
+    core.tick()
+    assert core.m["program_hits"] > hits0
+
+
+def test_wl_model_key_slot_separation():
+    """Two bank models must not share a dispatch — the model is a
+    static of the verdict, so it is part of the bucket key."""
+    core = VerifierCore(batch_cap=8)
+    hists, m_a = W.bank_batch(29, 1)
+    m_b = {"n": m_a["n"], "total": int(m_a["total"]) + 2}
+    for i, mm in enumerate((m_a, m_b)):
+        core.submit({"kind": "wl", "family": "bank", "id": i + 1,
+                     "wl": mm,
+                     "history": history_to_edn(list(hists[0]))},
+                    obs.monotonic())
+    done = core.tick()
+    assert len(done) == 2
+    assert all(rep["batched"] == 1 for _p, rep in done)
+    # same history, different total: exactly one model calls it wrong
+    assert sorted(rep["valid"] for _p, rep in done) == [False, True]
+
+
+def test_wl_host_degrade_past_ladder():
+    core = VerifierCore(batch_cap=8)
+    hist = [invoke(0, "write", 1), ok(0, "write", 1),
+            ok(1, "read", tuple([1] * (WLB.WL_NODES[-1] + 4)))]
+    p, r = core.submit({"kind": "wl", "family": "dirty", "id": 1,
+                        "history": history_to_edn(hist)},
+                       obs.monotonic())
+    assert r is None and p.bucket is None
+    hd0 = core.m["host_degraded"]
+    done = {pp.rid: rep for pp, rep in core.tick()}
+    rep = done[p.rid]
+    assert rep["engine"] == "host" and rep.get("degraded") is True
+    assert core.m["host_degraded"] == hd0 + 1
+
+
+def test_wl_bad_requests():
+    core = VerifierCore(batch_cap=8)
+    for i, (req, want) in enumerate((
+            ({"kind": "wl", "family": "nope", "history": "[]"},
+             "unknown"),
+            ({"kind": "wl", "family": "bank", "history": "[]"},
+             "bank"),
+            ({"kind": "wl", "family": "sets"}, "missing"),
+            ({"kind": "wl", "family": "sets", "history": "[{:type"},
+             "unparseable"))):
+        p, r = core.submit({**req, "id": i + 1}, obs.monotonic())
+        assert p is None and not r["ok"], (req, r)
+        assert want in r["message"], (want, r)
+
+
+def test_wl_stream_sessions_fuse_per_beat():
+    core = VerifierCore(batch_cap=8, max_sessions=4)
+    hists, m = W.bank_batch(37, 2)
+    sids = []
+    for i in (1, 2):
+        _, r = core.submit({"kind": "stream", "verb": "open",
+                            "id": i, "model": "wl-bank", "wl": m},
+                           obs.monotonic())
+        assert r["ok"] and r["model"] == "wl-bank", r
+        sids.append(r["session"])
+    # bad wl params reply bad-request without leaking a session
+    _, r = core.submit({"kind": "stream", "verb": "open", "id": 9,
+                        "model": "wl-bank"}, obs.monotonic())
+    assert not r["ok"] and "bad wl params" in r["message"], r
+    assert len(core.sessions) == 2
+
+    # two same-shape appends in one beat -> ONE fused program
+    d0, mb0 = SE.DISPATCHES, core.m["stream_megabatches"]
+    now = obs.monotonic()
+    for i, (sid, h) in enumerate(zip(sids, hists)):
+        p, r = core.submit({"kind": "stream", "verb": "append",
+                            "id": 20 + i, "session": sid,
+                            "history": history_to_edn(list(h))}, now)
+        assert r is None, r
+    done = core.tick()
+    assert SE.DISPATCHES - d0 == 1, SE.DISPATCHES - d0
+    assert core.m["stream_megabatches"] - mb0 == 1
+    oracle = W.check_wl_batch(hists, "bank", m)
+    for (_p, rep), o in zip(done, oracle):
+        assert rep["valid"] == o["valid?"], (rep, o)
+        assert rep["family"] == "bank"
+        assert abs(sum(rep["stages"].values())
+                   - rep["latency_ms"]) < 1.0
+
+    _, r = core.submit({"kind": "stream", "verb": "poll", "id": 30,
+                        "session": sids[0]}, obs.monotonic())
+    assert r["valid"] is True and r["family"] == "bank", r
+    _, r = core.submit({"kind": "stream", "verb": "close", "id": 31,
+                        "session": sids[0]}, obs.monotonic())
+    assert r["valid"] is True, r
+
+
+def test_wl_checkpoint_verb_migration():
+    core = VerifierCore(batch_cap=8, max_sessions=4)
+    hists, m = W.bank_batch(37, 2)
+    _, r = core.submit({"kind": "stream", "verb": "open", "id": 1,
+                        "model": "wl-bank", "wl": m}, obs.monotonic())
+    sid = r["session"]
+    p, r = core.submit({"kind": "stream", "verb": "append", "id": 2,
+                        "session": sid,
+                        "history": history_to_edn(list(hists[0]))},
+                       obs.monotonic())
+    assert r is None
+    core.tick()
+
+    # checkpoint with release is a MOVE: the donor forgets the session
+    _, r = core.submit({"kind": "stream", "verb": "checkpoint",
+                        "id": 3, "session": sid, "release": True},
+                       obs.monotonic())
+    assert r["ok"] and r["released"], r
+    wire = r["checkpoint"]
+    assert len(core.sessions) == 0
+
+    core2 = VerifierCore(batch_cap=8)
+    _, r = core2.submit({"kind": "stream", "verb": "open", "id": 1,
+                         "checkpoint": wire}, obs.monotonic())
+    assert r["ok"] and r.get("migrated"), r
+    sid2 = r["session"]
+    p, r = core2.submit({"kind": "stream", "verb": "append", "id": 2,
+                         "session": sid2,
+                         "history": history_to_edn(list(hists[1]))},
+                        obs.monotonic())
+    assert r is None
+    done = core2.tick()
+    assert len(done) == 1 and done[0][1]["valid"] is True, done
+    _, r = core2.submit({"kind": "stream", "verb": "close", "id": 3,
+                         "session": sid2}, obs.monotonic())
+    assert r["valid"] is True, r
+
+
+def test_wl_deadline_expiry_carries_kind_family():
+    core = VerifierCore(batch_cap=8)
+    hists, m = W.bank_batch(43, 1)
+    p, r = core.submit({"kind": "wl", "family": "bank", "id": 1,
+                        "wl": m,
+                        "history": history_to_edn(list(hists[0])),
+                        "deadline_ms": 0.0001}, obs.monotonic())
+    assert r is None
+    time.sleep(0.01)
+    done = core.pump(obs.monotonic())
+    assert len(done) == 1
+    rep = done[0][1]
+    assert rep["valid"] == "unknown" and rep["cause"] == "deadline"
+    assert rep["kind"] == "wl" and rep["family"] == "bank", rep
+    assert abs(sum(rep["stages"].values()) - rep["latency_ms"]) < 1.0

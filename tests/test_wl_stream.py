@@ -1,0 +1,232 @@
+"""Streaming wl sessions (ISSUE 20): bank / sets rungs.
+
+Stream verdicts bit-agree with the one-shot ``check_wl_batch`` on
+valid + violation twins, appends dispatch O(delta) (counter-asserted),
+megabatched advances are bit-identical to solo (verdicts AND carry
+bits), verdicts latch, checkpoints round-trip through host numpy, and
+the SessionManager evict/restore path preserves all of it.
+"""
+
+import numpy as np
+import pytest
+
+from comdb2_tpu.checker import wl as W
+from comdb2_tpu.ops.op import invoke, ok
+from comdb2_tpu.stream import engine as SE
+from comdb2_tpu.stream import wl as SW
+from comdb2_tpu.stream.manager import SessionManager
+
+
+# --- bank -------------------------------------------------------------------
+
+def test_bank_stream_matches_one_shot():
+    for viol in (None, "total", "n"):
+        hists, model = W.bank_batch(7, 3, violation=viol)
+        one = W.check_wl_batch(hists, "bank", model)
+        for h, o in zip(hists, one):
+            s = SW.make_session("wl-bank", model)
+            d0 = SE.DISPATCHES
+            third = len(h) // 3
+            for part in (h[:third], h[third:2 * third],
+                         h[2 * third:]):
+                s.append(part)
+            nd = SE.DISPATCHES - d0
+            out = s.close()
+            assert out["valid"] == o["valid?"], (viol, out, o)
+            if viol in ("total", "n"):
+                assert out["valid"] is False
+                assert out["op_index"] == max(
+                    i for i, op in enumerate(h)
+                    if op.type == "ok" and op.f == "read"), out
+                kind = "wrong-n" if viol == "n" else "wrong-total"
+                assert out["cause"] == f"{kind} read", out
+            # O(delta): at most one dispatch per nonempty delta
+            assert nd <= 3, nd
+
+
+def test_bank_snapshot_plane_stream():
+    hists, model = W.bank_batch(9, 2, violation="snapshot")
+    for h in hists:
+        s = SW.make_session("wl-bank", model)
+        s.append(h)
+        out = s.close()
+        assert out["valid"] is True, out
+        assert out["snapshot_inconsistent"] >= 1, out
+
+
+def test_bank_megabatch_bit_parity():
+    hists, model = W.bank_batch(11, 6)
+    solo = []
+    for h in hists:
+        s = SW.make_session("wl-bank", model)
+        fin = s.append_stage(h)
+        solo.append((fin(), np.asarray(s._balance).copy()))
+        s.close()
+
+    d0, m0 = SE.DISPATCHES, SE.MEGABATCHES
+    sess = [SW.make_session("wl-bank", model) for _ in hists]
+    coll = SE.MegaBatch()
+    fins = [s.append_stage(h, collector=coll)
+            for s, h in zip(sess, hists)]
+    coll.flush()
+    assert SE.DISPATCHES - d0 == 1, "6 lanes must fuse to one program"
+    assert SE.MEGABATCHES - m0 == 1
+    assert coll.fused_launches == 1 and coll.fused_lanes == 6
+    for s, fin, (so, sbal) in zip(sess, fins, solo):
+        fo = fin()
+        assert fo["valid"] == so["valid"]
+        assert fo["snapshot_inconsistent"] == so["snapshot_inconsistent"]
+        assert np.array_equal(np.asarray(s._balance), sbal), \
+            "fused carry must be bit-identical to solo"
+        s.close()
+
+
+def test_bank_latch():
+    hists, model = W.bank_batch(13, 1, violation="total")
+    s = SW.make_session("wl-bank", model)
+    s.append(hists[0])
+    d0 = SE.DISPATCHES
+    out = s.append(hists[0][:4])
+    assert out["valid"] is False and out.get("latched") is True, out
+    assert SE.DISPATCHES == d0, "latched append must not dispatch"
+
+
+def test_bank_checkpoint_restore():
+    hists, model = W.bank_batch(17, 1)
+    h = hists[0]
+    s = SW.make_session("wl-bank", model)
+    s.append(h[:len(h) // 2])
+    ck = s.checkpoint()
+    assert ck["wl_family"] == "bank"
+    assert isinstance(ck["balance"], np.ndarray), \
+        "checkpoints are host numpy only"
+    s2 = SW.restore_session(ck)
+    s.append(h[len(h) // 2:])
+    s2.append(h[len(h) // 2:])
+    o1, o2 = s.close(), s2.close()
+    assert o1["valid"] is True and o2["valid"] is True
+    assert o1["op_count"] == o2["op_count"]
+
+
+def test_bank_oversized_append_chunks():
+    """An append past the WL_DELTA_PADS top rung dispatches in
+    sequential solo chunks — same verdict, no open-ended program."""
+    hists, model = W.bank_batch(50, 1, n_transfers=100, n_reads=80)
+    one = W.check_wl_batch(hists, "bank", model)
+    s = SW.make_session("wl-bank", model)
+    d0 = SE.DISPATCHES
+    s.append(hists[0])
+    nd = SE.DISPATCHES - d0
+    out = s.close()
+    assert out["valid"] == one[0]["valid?"]
+    assert nd >= 2, nd
+
+
+# --- sets -------------------------------------------------------------------
+
+def test_sets_stream_matches_one_shot():
+    for viol in (None, "lost", "phantom"):
+        hists = W.sets_batch(5, 3, violation=viol)
+        one = W.check_wl_batch(hists, "sets")
+        for h, o in zip(hists, one):
+            s = SW.make_session("wl-sets")
+            half = len(h) // 2
+            r1 = s.append(h[:half])
+            assert r1["valid"] is True, \
+                "sets must stay provisional mid-stream"
+            s.append(h[half:])
+            out = s.close()
+            assert out["valid"] == o["valid?"], (viol, out, o)
+
+
+def test_sets_never_read_unknown():
+    s = SW.make_session("wl-sets")
+    h = W.sets_batch(6, 1)[0]
+    s.append([op for op in h if op.f != "read"])
+    out = s.close()
+    assert out["valid"] == "unknown", out
+    assert out["cause"] == "Set was never read", out
+
+
+def test_sets_malformed_read_latches_unknown():
+    s = SW.make_session("wl-sets")
+    s.append([ok(0, "read", "abc")])
+    out = s.poll()
+    assert out["valid"] == "unknown" and "malformed" in out["cause"]
+
+
+def test_sets_escalation_in_place():
+    s = SW.make_session("wl-sets")
+    ops = []
+    for v in range(300):
+        ops.append(invoke(v, "add", v))
+        ops.append(ok(v, "add", v))
+    s.append(ops[:100])
+    assert s.e_pad == 128
+    s.append(ops[100:])
+    assert s.e_pad == 1024, "element universe must climb the rung"
+    assert s.escalations == 1
+    s.append([ok(301, "read", tuple(range(300)))])
+    out = s.close()
+    assert out["valid"] is True, out
+
+
+def test_sets_megabatch_bit_parity():
+    hists = W.sets_batch(21, 4)
+    solo = []
+    for h in hists:
+        s = SW.make_session("wl-sets")
+        s.append(h)
+        solo.append((s.poll(), np.asarray(s._fr).copy()))
+        s.close()
+    d0, m0 = SE.DISPATCHES, SE.MEGABATCHES
+    sess = [SW.make_session("wl-sets") for _ in hists]
+    coll = SE.MegaBatch()
+    fins = [s.append_stage(h, collector=coll)
+            for s, h in zip(sess, hists)]
+    coll.flush()
+    assert SE.DISPATCHES - d0 == 1 and SE.MEGABATCHES - m0 == 1
+    for s, fin, (so, sfr) in zip(sess, fins, solo):
+        fo = fin()
+        assert (fo["lost"], fo["unexpected"]) == \
+            (so["lost"], so["unexpected"])
+        assert np.array_equal(np.asarray(s._fr), sfr), "carry bits"
+        s.close()
+
+
+def test_sets_checkpoint_restore():
+    h = W.sets_batch(30, 1)[0]
+    s = SW.make_session("wl-sets")
+    s.append(h[:20])
+    ck = s.checkpoint()
+    s2 = SW.restore_session(ck)
+    assert s2._ids == s._ids, "interning table must survive verbatim"
+    s.append(h[20:])
+    s2.append(h[20:])
+    o1, o2 = s.close(), s2.close()
+    assert o1["valid"] == o2["valid"], (o1, o2)
+    assert o1["lost"] == o2["lost"]
+
+
+# --- manager integration ----------------------------------------------------
+
+def test_manager_open_evict_restore_close():
+    mgr = SessionManager(max_sessions=4, idle_s=10.0)
+    hists, model = W.bank_batch(40, 1)
+    sid, s = mgr.open(0.0, model="wl-bank", wl=model)
+    s.append(hists[0][:6])
+    mgr.evict_idle(100.0)
+    assert len(mgr) == 0 and mgr.checkpoint_count() == 1, \
+        "idle eviction is checkpoint-not-replay"
+    s2 = mgr.get(sid, 101.0)
+    assert s2 is not None and s2.family == "bank"
+    s2.append(hists[0][6:])
+    out = mgr.close(sid)
+    assert out["valid"] is True, out
+
+
+def test_bad_model_params():
+    with pytest.raises(ValueError):
+        SW.make_session("wl-bank")        # bank needs {'n','total'}
+    with pytest.raises(ValueError):
+        SW.make_session("wl-nope")
